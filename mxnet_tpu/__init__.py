@@ -1,0 +1,60 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet's API surface.
+
+A ground-up rebuild of Apache MXNet 1.x's capabilities (reference:
+ddlee96/incubator-mxnet, surveyed in SURVEY.md) designed TPU-first:
+
+- the C++ dependency engine is replaced by JAX/XLA async dispatch;
+- the ~1000-op C++/CUDA zoo is a single registry of pure jax functions that
+  XLA fuses and tiles onto the MXU (plus Pallas kernels for flash attention);
+- ``hybridize()`` stages Gluon models into ``jax.jit`` computations instead
+  of NNVM graphs;
+- KVStore data-parallelism is XLA collectives over the ICI/DCN mesh
+  (``dist_tpu_sync``) instead of ps-lite/NCCL.
+
+Typical use — identical to the reference's surface:
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, autograd
+
+    ctx = mx.tpu()
+    net = gluon.model_zoo.vision.resnet50_v1()
+    net.initialize(ctx=ctx)
+    net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), 'sgd', {'learning_rate': 0.1})
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(batch_size)
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+from . import context
+from . import base
+from . import autograd
+from . import random
+from . import ndarray
+from . import ndarray as nd
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import kvstore
+from . import kvstore as kv
+from . import gluon
+from . import io
+from . import recordio
+from . import image
+from . import profiler
+from . import util
+from .util import test_utils
+from . import runtime
+from . import callback
+from . import monitor
+from . import parallel
+
+from .ndarray import NDArray

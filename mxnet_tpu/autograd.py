@@ -1,0 +1,312 @@
+"""Imperative autograd: tape recording + backward via per-op ``jax.vjp``.
+
+Reference semantics: ``python/mxnet/autograd.py`` (record/pause/train_mode/
+predict_mode scopes, backward, mark_variables) with the C++ tape in
+``src/imperative/imperative.cc`` (Imperative::RecordOp builds AGInfo nodes;
+Imperative::Backward applies the nnvm "Gradient" pass) — SURVEY.md §3.5, §4.2.
+
+TPU-native design: instead of a graph-IR Gradient pass, every recorded op
+captures a *pure function* plus its input values (jax arrays are immutable,
+so snapshots are free) and its ``jax.vjp`` residuals at record time.
+``backward()`` walks the tape in reverse topological order accumulating
+cotangents.  This supports the imperative API (per-op backward, grad_req
+write/add, retain_graph) that a whole-function ``jax.grad`` cannot express —
+exactly the reason the reference keeps a tape beside its symbolic executor.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _State()
+
+
+def is_recording():
+    return _STATE.recording
+
+
+def is_training():
+    return _STATE.training
+
+
+def set_recording(flag):
+    prev = _STATE.recording
+    _STATE.recording = bool(flag)
+    return prev
+
+
+def set_training(flag):
+    prev = _STATE.training
+    _STATE.training = bool(flag)
+    return prev
+
+
+@contextmanager
+def _scope(recording=None, training=None):
+    prev_r, prev_t = _STATE.recording, _STATE.training
+    if recording is not None:
+        _STATE.recording = recording
+    if training is not None:
+        _STATE.training = training
+    try:
+        yield
+    finally:
+        _STATE.recording, _STATE.training = prev_r, prev_t
+
+
+def record(train_mode=True):
+    """``with autograd.record():`` — turn on tape recording (and train mode)."""
+    return _scope(recording=True, training=train_mode)
+
+
+def pause(train_mode=False):
+    return _scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _scope(training=True)
+
+
+def predict_mode():
+    return _scope(training=False)
+
+
+# --------------------------------------------------------------------------
+# Tape structures
+# --------------------------------------------------------------------------
+class Entry:
+    """A differentiable value on the tape: either an op output (node, oidx)
+    or a marked variable (node is None)."""
+
+    __slots__ = ("node", "oidx", "variable", "grad_req", "shape", "dtype")
+
+    def __init__(self, node=None, oidx=0, variable=None, grad_req="write",
+                 shape=None, dtype=None):
+        self.node = node
+        self.oidx = oidx
+        self.variable = variable  # the NDArray handle for marked variables
+        self.grad_req = grad_req
+        self.shape = shape
+        self.dtype = dtype
+
+
+class Node:
+    """One recorded op: pure fn + input entries + vjp residuals."""
+
+    __slots__ = ("vjp_fn", "in_entries", "out_entries", "out_avals", "name",
+                 "multi")
+
+    def __init__(self, vjp_fn, in_entries, out_avals, name="", multi=False):
+        self.vjp_fn = vjp_fn
+        self.in_entries = in_entries  # list[Entry|None], aligned with vjp cotangent outputs
+        self.out_entries = []         # filled by record_op
+        self.out_avals = out_avals    # list[(shape, dtype)]
+        self.name = name
+        self.multi = multi            # original fn returned a tuple
+
+
+def record_op(fn, in_vals, in_entries, name=""):
+    """Record one op execution. Returns (out_vals, out_entries).
+
+    ``fn`` must be a pure function of ``*in_vals`` (attrs already closed
+    over).  Called only when recording AND at least one input is on the tape.
+    Reference: Imperative::RecordOp (src/imperative/imperative.cc).
+    """
+    import jax
+
+    out_vals, vjp_fn = jax.vjp(fn, *in_vals)
+    multi = isinstance(out_vals, (tuple, list))
+    outs = list(out_vals) if multi else [out_vals]
+    node = Node(vjp_fn, list(in_entries),
+                [(o.shape, o.dtype) for o in outs], name=name, multi=multi)
+    node.out_entries = [Entry(node=node, oidx=i, shape=o.shape, dtype=o.dtype)
+                        for i, o in enumerate(outs)]
+    return out_vals, node.out_entries, multi
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to NDArrays (reference: MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._mark_variable(g, req)
+
+
+# --------------------------------------------------------------------------
+# Backward
+# --------------------------------------------------------------------------
+def _topo_nodes(head_entries):
+    """Reverse-topological order of nodes reachable from the heads."""
+    order, state = [], {}  # state: 0 visiting, 1 done
+
+    def visit(node):
+        stack = [(node, False)]
+        while stack:
+            n, processed = stack.pop()
+            if processed:
+                state[id(n)] = 1
+                order.append(n)
+                continue
+            st = state.get(id(n))
+            if st is not None:
+                continue
+            state[id(n)] = 0
+            stack.append((n, True))
+            for e in n.in_entries:
+                if e is not None and e.node is not None and state.get(id(e.node)) is None:
+                    stack.append((e.node, False))
+
+    for e in head_entries:
+        if e is not None and e.node is not None and state.get(id(e.node)) is None:
+            visit(e.node)
+    order.reverse()
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from ``heads`` (list of NDArray), accumulating into the
+    ``.grad`` buffers of marked variables.
+
+    Reference: Imperative::Backward (src/imperative/imperative.cc, SURVEY.md
+    §4.2): builds grad graph from tape, executes with inplace-addto.
+    Here: reverse-topo walk calling each node's stored ``vjp_fn``.
+    """
+    import jax.numpy as jnp
+
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    cot = {}  # id(Entry) -> cotangent jax array
+    written = set()  # variables written THIS backward (write-req semantics:
+    #                  each backward overwrites; contributions within one
+    #                  backward accumulate — matching the reference)
+
+    def add_cot(entry, val):
+        k = id(entry)
+        if k in cot:
+            cot[k] = cot[k] + val
+        else:
+            cot[k] = val
+
+    head_entries = []
+    for h, hg in zip(heads, head_grads):
+        e = h._ag_entry
+        if e is None:
+            raise MXNetError(
+                "cannot differentiate a head that was not computed under "
+                "autograd.record() from marked variables"
+            )
+        head_entries.append(e)
+        if hg is None:
+            g = jnp.ones(h.shape, dtype=h.dtype)
+        else:
+            g = hg._get() if hasattr(hg, "_get") else jnp.asarray(hg)
+        add_cot(e, g)
+
+    for node in _topo_nodes(head_entries):
+        outs = []
+        have_any = False
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            e = node.out_entries[i]
+            c = cot.pop(id(e), None)
+            if c is None:
+                c = jnp.zeros(shape, dtype=dtype)
+            else:
+                have_any = True
+            outs.append(c)
+        if not have_any:
+            continue
+        if node.vjp_fn is None:
+            raise MXNetError(
+                f"backward through node {node.name!r} a second time without "
+                "retain_graph=True"
+            )
+        cotan_in = node.vjp_fn(tuple(outs) if node.multi else outs[0])
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+        for e, c in zip(node.in_entries, cotan_in):
+            if e is None or c is None:
+                continue
+            if e.variable is not None:
+                _accum_grad(e, c, written)
+            else:
+                add_cot(e, c)
+
+    # cotangents that landed directly on variable heads (identity case)
+    for e in head_entries:
+        if e.variable is not None and id(e) in cot:
+            _accum_grad(e, cot.pop(id(e)), written)
+
+
+def _accum_grad(entry, c, written):
+    var = entry.variable
+    req = entry.grad_req
+    if req == "null" or var is None:
+        return
+    grad_nd = var._grad
+    if grad_nd is None:
+        return
+    if req == "add":
+        grad_nd._set(grad_nd._get() + c)
+    elif id(var) in written:  # multiple uses within ONE backward accumulate
+        grad_nd._set(grad_nd._get() + c)
+    else:  # 'write': first contribution of this backward overwrites
+        grad_nd._set(c.astype(grad_nd.dtype) if c.dtype != grad_nd.dtype else c)
+        written.add(id(var))
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Functional gradient: returns grads of heads w.r.t. variables without
+    touching ``.grad`` buffers (reference: mx.autograd.grad)."""
+    if create_graph:
+        raise NotImplementedError("create_graph=True (higher-order imperative "
+                                  "grad) is not supported yet; use nd.grad_fn "
+                                  "or hybridize + jax.grad composition")
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+    from .ndarray import ndarray as _ndm
+    saved = [(v._grad, v._ag_entry) for v in variables]
+    try:
+        zeros = [_ndm.NDArray._from_jax(_zeros_like(v._get()), v.context) for v in variables]
+        mark_variables(list(variables), zeros)
+        backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+        return [v._grad for v in variables]
+    finally:
+        for v, (g, e) in zip(variables, saved):
+            v._grad, v._ag_entry = g, e
+
+
+def _zeros_like(x):
+    import jax.numpy as jnp
+
+    return jnp.zeros(x.shape, x.dtype)

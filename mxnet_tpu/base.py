@@ -1,0 +1,84 @@
+"""Base utilities: errors, registry, dtype tables.
+
+TPU-native rebuild of the role played by ``python/mxnet/base.py`` and
+``3rdparty/dmlc-core`` (logging/CHECK -> dmlc::Error -> MXNetError) in the
+reference (see SURVEY.md §3.5, §3.8).  There is no C ABI here: the "engine"
+is the JAX/XLA runtime, so errors are ordinary Python exceptions raised
+either at call time (shape/type inference) or at sync points (async XLA
+errors surfacing in ``wait_to_read``/``asnumpy`` — same contract as the
+reference's exception-on-var propagation, SURVEY.md §3.1).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["MXNetError", "Registry", "string_types", "numeric_types", "integer_types"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (reference: MXGetLastError TLS,
+    src/c_api/c_api_error.cc)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+# dtype name <-> numpy mapping (reference: mshadow dtype enum via
+# python/mxnet/base.py _DTYPE_NP_TO_MX / _DTYPE_MX_TO_NP)
+_DTYPE_ALIASES = {
+    "float32": _np.float32,
+    "float64": _np.float64,
+    "float16": _np.float16,
+    "bfloat16": "bfloat16",  # resolved lazily via ml_dtypes/jnp
+    "uint8": _np.uint8,
+    "int8": _np.int8,
+    "int32": _np.int32,
+    "int64": _np.int64,
+    "bool": _np.bool_,
+}
+
+
+class Registry:
+    """Minimal name->object registry with decorator support.
+
+    Reference: ``dmlc::Registry`` (3rdparty/dmlc-core/include/dmlc/registry.h)
+    which backs the op/iterator/storage factories.  The TPU build keeps the
+    registry-driven, self-describing surface (SURVEY.md §6.6) in pure Python.
+    """
+
+    def __init__(self, name):
+        self.name = name
+        self._fmap = {}
+
+    def register(self, obj=None, name=None, aliases=()):
+        def _do(o):
+            key = name or getattr(o, "__name__", None)
+            if key is None:
+                raise ValueError("cannot infer registry key")
+            self._fmap[key.lower()] = o
+            for a in aliases:
+                self._fmap[a.lower()] = o
+            return o
+
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def create(self, key, *args, **kwargs):
+        k = key.lower()
+        if k not in self._fmap:
+            raise MXNetError(
+                f"{self.name} registry: unknown entry {key!r}. "
+                f"Known: {sorted(self._fmap)}"
+            )
+        return self._fmap[k](*args, **kwargs)
+
+    def get(self, key):
+        return self._fmap.get(key.lower())
+
+    def __contains__(self, key):
+        return key.lower() in self._fmap
+
+    def keys(self):
+        return sorted(self._fmap)

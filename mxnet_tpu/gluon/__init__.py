@@ -1,0 +1,15 @@
+"""Gluon: the define-by-run high-level API (reference: python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import rnn
+from . import data
+from . import model_zoo
+from . import contrib
+from . import utils
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "loss", "rnn", "data", "model_zoo",
+           "contrib", "utils"]

@@ -1,0 +1,473 @@
+"""Gluon Block / HybridBlock: define-by-run layers with jit staging.
+
+Reference: ``python/mxnet/gluon/block.py`` (~1.5k LoC — Block child/param
+registration, forward hooks, save/load_parameters; HybridBlock._build_cache
+traces ``hybrid_forward`` with Symbol proxies into a CachedOp; SymbolBlock —
+SURVEY.md §3.5, §4.6).
+
+TPU-native staging: ``hybridize()`` swaps the Symbol trace for a ``jax.jit``
+trace (SURVEY.md §4.6 calls this "the exact seam where the TPU build swaps in
+jax.jit").  The cached computation is a pure function
+
+    fn(param_values, rng_key, *input_values) -> (outputs..., state_updates...)
+
+jit-compiled per (input avals, training-mode, param dtypes).  Parameters ride
+as arguments (not constants) so the same executable serves every step;
+running-state mutations (BatchNorm moving stats) are threaded out as extra
+outputs and written back to their Parameters after the call — the functional
+equivalent of the reference's stateful FCompute.  Under ``autograd.record``
+the whole cached op lands on the tape as ONE node whose vjp is jax's vjp of
+the jitted function (≙ CachedOp backward caching).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from .. import autograd as _ag
+from .. import ndarray as _F
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope(threading.local):
+    def __init__(self):
+        self.counters = {}
+
+    def next_name(self, hint):
+        n = self.counters.get(hint, 0)
+        self.counters[hint] = n + 1
+        return f"{hint}{n}_"
+
+
+_NAME_SCOPE = _BlockScope()
+
+
+class _TraceState(threading.local):
+    def __init__(self):
+        self.ctx = None  # active _TraceContext or None
+
+
+_TRACE = _TraceState()
+
+
+class _TraceContext:
+    """Active while hybrid_forward is being traced under jax.jit."""
+
+    def __init__(self, param_map):
+        self.param_map = param_map          # Parameter -> traced NDArray
+        self.state_updates = []             # [(Parameter, jax value)]
+
+
+class Block:
+    """Base container (reference: gluon.Block)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix = prefix if prefix is not None else _NAME_SCOPE.next_name(
+            self._alias())
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children = OrderedDict()
+        self._reg_params = OrderedDict()
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+
+    @property
+    def params(self):
+        return self._params
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = getattr(self, "_children", None)
+            if existing is not None:
+                self._children[name] = value
+        elif isinstance(value, Parameter):
+            if getattr(self, "_reg_params", None) is not None:
+                self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+        return block
+
+    def register_forward_hook(self, hook):
+        key = len(self._forward_hooks)
+        self._forward_hooks[key] = hook
+        return _HookHandle(self._forward_hooks, key)
+
+    def register_forward_pre_hook(self, hook):
+        key = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[key] = hook
+        return _HookHandle(self._forward_pre_hooks, key)
+
+    def collect_params(self, select=None):
+        """All Parameters of self + descendants (reference semantics)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pattern = re.compile(select)
+            ret.update({k: v for k, v in self._params.items() if pattern.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, p in self._params.items():
+            p.cast(dtype)
+        self._bump_cache_version()
+
+    def _bump_cache_version(self):
+        pass
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def save_parameters(self, filename, deduplicate=False):
+        """Reference: Block.save_parameters — params only, by block-path name."""
+        params = self._collect_params_with_prefix()
+        from ..ndarray.serialization import save as _save
+
+        _save(filename, {k: v.data() for k, v in params.items()})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        from ..ndarray.serialization import load as _load
+
+        loaded = _load(filename)
+        params = self._collect_params_with_prefix()
+        if not allow_missing:
+            for name in params:
+                if name not in loaded:
+                    raise MXNetError(f"Parameter {name} missing in {filename}")
+        for name, v in loaded.items():
+            if name not in params:
+                if not ignore_extra:
+                    raise MXNetError(f"Parameter {name} in file not in Block "
+                                     "(set ignore_extra=True)")
+                continue
+            p = params[name]
+            if p._data is None:
+                p.shape = v.shape
+                p.initialize(ctx=ctx or [current_context()])
+            p.set_data(v)
+        self._bump_cache_version()
+
+    # legacy aliases
+    save_params = save_parameters
+    load_params = load_parameters
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary (reference: Block.summary)."""
+        rows = []
+
+        def make_hook(name, block):
+            def hook(blk, inp, out):
+                shape = out.shape if hasattr(out, "shape") else \
+                    [o.shape for o in out] if isinstance(out, (list, tuple)) else "?"
+                n_params = sum(int(_np.prod(p.shape)) for p in
+                               blk._reg_params.values() if p._shape_known())
+                rows.append((name or "self", type(blk).__name__, shape, n_params))
+            return hook
+
+        handles = []
+        for name, child in self._children.items():
+            handles.append(child.register_forward_hook(make_hook(name, child)))
+        try:
+            self(*inputs)
+        finally:
+            for h in handles:
+                h.detach()
+        header = f"{'Layer':<24}{'Type':<20}{'Output shape':<24}{'Params':<12}"
+        print(header)
+        print("-" * len(header))
+        total = 0
+        for name, typ, shape, n in rows:
+            print(f"{name:<24}{typ:<20}{str(shape):<24}{n:<12}")
+            total += n
+        print("-" * len(header))
+        print(f"Total params (shown layers): {total}")
+
+    def __repr__(self):
+        s = f"{type(self).__name__}(\n"
+        for key, child in self._children.items():
+            s += f"  ({key}): {repr(child)}\n"
+        return s + ")"
+
+
+class _HookHandle:
+    def __init__(self, hooks, key):
+        self._hooks, self._key = hooks, key
+
+    def detach(self):
+        self._hooks.pop(self._key, None)
+
+
+class HybridBlock(Block):
+    """Block that can be staged into a jit-compiled cached op.
+
+    Subclasses implement ``hybrid_forward(F, x, *args, **params)`` — same
+    contract as the reference (F is the op namespace; registered params are
+    passed as kwargs).
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._flags = {}
+        self._cached_graph = {}
+        self._cache_version = 0
+
+    def _bump_cache_version(self):
+        self._cache_version += 1
+        self._cached_graph = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=2, forward_bulk_size=None, backward_bulk_size=None):
+        """Reference: HybridBlock.hybridize (flags map to CachedOp config;
+        here jit owns memory planning so the flags are accepted no-ops)."""
+        self._active = active
+        self._flags = {"static_alloc": static_alloc, "static_shape": static_shape}
+        self._cached_graph = {}
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape)
+
+    def cast(self, dtype):
+        self._cached_graph = {}
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Resolve deferred param shapes from input shapes.  Parametric leaf
+        layers override this; containers resolve compositionally."""
+        raise MXNetError(
+            f"{type(self).__name__} has deferred-init parameters but does not "
+            "implement infer_shape; give explicit in_units/in_channels or "
+            "run one eager forward first")
+
+    # -- eager path --------------------------------------------------------
+    def _resolve_params(self, *args):
+        kwargs = {}
+        tc = _TRACE.ctx
+        for name, p in self._reg_params.items():
+            if tc is not None and p in tc.param_map:
+                kwargs[name] = tc.param_map[p]
+                continue
+            try:
+                kwargs[name] = p.data()
+            except DeferredInitializationError:
+                self.infer_shape(*args)
+                p._finish_deferred_init()
+                kwargs[name] = p.data()
+        return kwargs
+
+    def _update_running_state(self, param, new_value_nd):
+        """Write a non-differentiable state update (BatchNorm moving stats).
+        Traced: collected as an extra jit output; eager: written in place."""
+        tc = _TRACE.ctx
+        val = new_value_nd._get() if isinstance(new_value_nd, NDArray) else new_value_nd
+        if tc is not None:
+            tc.state_updates.append((param, val))
+        else:
+            with _ag.pause():
+                param.data()._set(val)
+
+    def forward(self, x, *args):
+        if self._active and isinstance(x, NDArray) and _TRACE.ctx is None:
+            return self._call_cached_op(x, *args)
+        params = self._resolve_params(x, *args)
+        return self.hybrid_forward(_F, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- cached (jit) path -------------------------------------------------
+    def _call_cached_op(self, *args):
+        """Reference: _call_cached_op -> CachedOp::Forward (SURVEY.md §4.2).
+        Here the cached op is a jax.jit'd pure function."""
+        import jax
+
+        # deferred param shapes unresolved -> run the eager path once (it
+        # settles them, recording normally); the next call builds the cache
+        all_params = [p for _, p in sorted(self.collect_params().items())]
+        if any(p._data is None for p in all_params):
+            params = self._resolve_params(*args)
+            return self.hybrid_forward(_F, *args, **params)
+
+        in_vals = [a._get() if isinstance(a, NDArray) else a for a in args]
+        key = (tuple((tuple(v.shape), str(v.dtype)) for v in in_vals),
+               _ag.is_training(), _ag.is_recording(), self._cache_version)
+        entry = self._cached_graph.get(key)
+        if entry is None:
+            entry = self._build_cache(key, all_params, args)
+        jitted, params_list, n_state = entry
+
+        param_vals = [p.data()._get() for p in params_list]
+        from .. import random as _rnd
+        from jax import random as _jr
+
+        rng_key = _rnd._next_key()
+
+        flat_in = param_vals + in_vals
+        if _ag.is_recording():
+            def fn_for_tape(*flat):
+                pv = list(flat[:len(param_vals)])
+                iv = list(flat[len(param_vals):])
+                return jitted(pv, rng_key, *iv)
+
+            entries = [p.data()._ag_entry for p in params_list] + \
+                      [(a._ag_entry if isinstance(a, NDArray) else None) for a in args]
+            out_vals, out_entries, _ = _ag.record_op(fn_for_tape, flat_in, entries,
+                                                     name=f"cached_op:{self.name}")
+        else:
+            out_vals = jitted(param_vals, rng_key, *in_vals)
+            out_entries = None
+
+        out_vals = list(out_vals)
+        state_vals = out_vals[len(out_vals) - n_state:] if n_state else []
+        real_vals = out_vals[:len(out_vals) - n_state] if n_state else out_vals
+
+        # write state updates back (BatchNorm stats etc.)
+        state_params = self._cached_state_params.get(key, [])
+        with _ag.pause():
+            for p, v in zip(state_params, state_vals):
+                p.data()._set(v)
+
+        ctx = args[0].context if isinstance(args[0], NDArray) else current_context()
+        outs = []
+        for i, v in enumerate(real_vals):
+            o = NDArray._from_jax(v, ctx)
+            if out_entries is not None:
+                o._ag_entry = out_entries[i]
+            outs.append(o)
+        if self._cached_single.get(key, len(outs) == 1):
+            return outs[0]
+        return tuple(outs)
+
+    def _build_cache(self, key, all_params, args):
+        """Trace hybrid_forward once into a jit executable (reference:
+        _build_cache / CachedOp construction, SURVEY.md §4.6)."""
+        import jax
+
+        params_list = all_params
+        training = _ag.is_training()
+        if not hasattr(self, "_cached_state_params"):
+            self._cached_state_params = {}
+            self._cached_single = {}
+
+        state_params_box = []
+        single_box = []
+        block = self
+
+        def fn(param_vals, rng_key, *input_vals):
+            from .. import random as _rnd
+
+            pmap = {}
+            for p, v in zip(params_list, param_vals):
+                nd = NDArray._from_jax(v, None)
+                pmap[p] = nd
+            tc = _TraceContext(pmap)
+            prev = _TRACE.ctx
+            _TRACE.ctx = tc
+            _rnd._push_trace_key(rng_key)
+            prev_rec = _ag.set_recording(False)
+            try:
+                nd_args = [NDArray._from_jax(v, None) for v in input_vals]
+                out = block.forward(*nd_args)
+            finally:
+                _ag.set_recording(prev_rec)
+                _rnd._pop_trace_key()
+                _TRACE.ctx = prev
+            if isinstance(out, NDArray):
+                outs = [out._get()]
+                single = True
+            else:
+                outs = [o._get() for o in out]
+                single = False
+            state_params = [p for p, _ in tc.state_updates]
+            state_vals = [v for _, v in tc.state_updates]
+            if not state_params_box:
+                state_params_box.append(state_params)
+                single_box.append(single)
+            return tuple(outs + state_vals)
+
+        jitted = jax.jit(fn, static_argnums=())
+        # run an abstract trace to discover state updates & output arity
+        in_vals = [a._get() if isinstance(a, NDArray) else a for a in args]
+        param_vals = [p.data()._get() for p in params_list]
+        from jax import random as _jr
+
+        _ = jax.eval_shape(fn, param_vals, _jr.PRNGKey(0), *in_vals)
+        state_params = state_params_box[0]
+        n_state = len(state_params)
+        self._cached_state_params[key] = state_params
+        self._cached_single[key] = single_box[0]
+        entry = (jitted, params_list, n_state)
+        self._cached_graph[key] = entry
+        return entry
+
+    def export(self, path, epoch=0):
+        """Reference: HybridBlock.export -> symbol.json + .params.  Here:
+        save params in .params format; graph export lands with the Symbol
+        layer."""
+        params = self._collect_params_with_prefix()
+        from ..ndarray.serialization import save as _save
+
+        _save(f"{path}-{epoch:04d}.params",
+              {f"arg:{k}": v.data() for k, v in params.items()})
+
+
+class SymbolBlock(HybridBlock):
+    """Placeholder until the Symbol layer lands (phase 7, SURVEY.md §8)."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError("SymbolBlock requires the symbol layer "
+                                  "(arriving with the Module API)")

@@ -1,0 +1,6 @@
+"""Gluon contrib (reference: python/mxnet/gluon/contrib/ — SyncBatchNorm,
+Concurrent, Identity, estimator — SURVEY.md §3.5)."""
+from . import nn
+from .estimator import Estimator
+
+__all__ = ["nn", "Estimator"]

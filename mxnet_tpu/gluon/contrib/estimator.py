@@ -1,0 +1,87 @@
+"""Estimator train loop (reference 1.6: python/mxnet/gluon/contrib/estimator/)."""
+from __future__ import annotations
+
+import time
+
+from ... import autograd
+from ... import metric as metric_mod
+from ...base import MXNetError
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd"]
+
+
+class EventHandler:
+    pass
+
+
+class TrainBegin(EventHandler):
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd(EventHandler):
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin(EventHandler):
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd(EventHandler):
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin(EventHandler):
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd(EventHandler):
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class Estimator:
+    """Minimal fit() loop driving net/loss/trainer/metrics."""
+
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None, val_metrics=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = [metric_mod.create(m) for m in
+                              (train_metrics or ["accuracy"])]
+        self.trainer = trainer
+        self.context = context
+
+    def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
+            batch_size=None):
+        if self.trainer is None:
+            raise MXNetError("Estimator needs a trainer")
+        history = []
+        for epoch in range(epochs):
+            for m in self.train_metrics:
+                m.reset()
+            tic = time.time()
+            nsamples = 0
+            for batch in train_data:
+                data, label = batch[0], batch[1]
+                bs = data.shape[0]
+                with autograd.record():
+                    out = self.net(data)
+                    loss = self.loss(out, label)
+                loss.backward()
+                self.trainer.step(bs)
+                nsamples += bs
+                for m in self.train_metrics:
+                    m.update([label], [out])
+            elapsed = time.time() - tic
+            stats = {name: val for name, val in
+                     (m.get() for m in self.train_metrics)}
+            stats["throughput"] = nsamples / max(elapsed, 1e-9)
+            stats["epoch"] = epoch
+            history.append(stats)
+        return history

@@ -1,0 +1,62 @@
+"""Contrib layers (reference: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn.basic_layers import BatchNorm, Sequential, HybridSequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SyncBatchNorm"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs (reference:
+    gluon.contrib.nn.Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as F
+
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference: src/operator/contrib/
+    sync_batch_norm.cc — the only activation-space collective in MXNet 1.x).
+
+    TPU-native: under a sharded jit step the batch axis is already global, so
+    plain BatchNorm statistics computed inside shard_map with a psum ARE
+    sync-BN; in the imperative single-process path this degenerates to
+    BatchNorm (same as the reference with ndev=1).
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)

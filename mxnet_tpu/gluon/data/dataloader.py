@@ -1,0 +1,96 @@
+"""DataLoader with background prefetch.
+
+Reference: ``python/mxnet/gluon/data/dataloader.py`` (_MultiWorkerIter with
+multiprocessing workers + POSIX-shm zero-copy batches — SURVEY.md §3.4).
+
+TPU-native: worker processes would fight the TPU runtime for the process
+space; the idiomatic host-side pipeline is a thread pool (NumPy decode
+releases the GIL in the hot paths) feeding a device-prefetch queue —
+same shape as the reference's parser→batcher→prefetcher pipeline (§4.5).
+``num_workers`` maps to the thread pool size.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as _np
+
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: default_batchify_fn)."""
+    from ...ndarray.ndarray import NDArray, array
+
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+
+        return array(_np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        return tuple(default_batchify_fn(list(d)) for d in zip(*data))
+    arr = _np.asarray(data)
+    return array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=True):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+        self._prefetch = max(0, prefetch or 2 * max(num_workers, 1))
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch])
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        pool = ThreadPoolExecutor(max_workers=self._num_workers)
+        batches = list(self._batch_sampler)
+
+        def load(batch):
+            return self._batchify_fn([self._dataset[i] for i in batch])
+
+        try:
+            futures = queue.Queue()
+            it = iter(batches)
+            # prime the prefetch window
+            primed = 0
+            for batch in it:
+                futures.put(pool.submit(load, batch))
+                primed += 1
+                if primed >= self._prefetch:
+                    break
+            while not futures.empty():
+                f = futures.get()
+                try:
+                    nxt = next(it)
+                    futures.put(pool.submit(load, nxt))
+                except StopIteration:
+                    pass
+                yield f.result()
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,68 @@
+"""Model zoo vision entrypoint (reference:
+python/mxnet/gluon/model_zoo/vision/__init__.py get_model)."""
+from ....base import MXNetError
+from .resnet import *  # noqa: F401,F403
+from .resnet import __all__ as _resnet_all
+
+_models = {}
+for _n in _resnet_all:
+    if _n.startswith("resnet") and _n[6].isdigit():
+        _models[_n] = globals()[_n]
+
+
+def _register_lazy():
+    """Models added as families land; get_model sees them automatically."""
+    try:
+        from . import alexnet as _a
+
+        _models["alexnet"] = _a.alexnet
+    except ImportError:
+        pass
+    try:
+        from . import vgg as _v
+
+        for n in _v.__all__:
+            if n.startswith("vgg") and n[3].isdigit():
+                _models[n] = getattr(_v, n)
+    except ImportError:
+        pass
+    try:
+        from . import mobilenet as _m
+
+        for n in _m.__all__:
+            if n.startswith("mobilenet") and not n[0].isupper():
+                _models[n] = getattr(_m, n)
+    except ImportError:
+        pass
+    try:
+        from . import squeezenet as _s
+
+        for n in _s.__all__:
+            if n.startswith("squeezenet") and n[10].isdigit():
+                _models[n] = getattr(_s, n)
+    except ImportError:
+        pass
+    try:
+        from . import densenet as _d
+
+        for n in _d.__all__:
+            if n.startswith("densenet") and n[8].isdigit():
+                _models[n] = getattr(_d, n)
+    except ImportError:
+        pass
+    try:
+        from . import inception as _i
+
+        _models["inceptionv3"] = _i.inception_v3
+    except ImportError:
+        pass
+
+
+_register_lazy()
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(f"model {name} not found; available: {sorted(_models)}")
+    return _models[name](**kwargs)

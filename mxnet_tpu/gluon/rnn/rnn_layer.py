@@ -1,0 +1,241 @@
+"""Fused recurrent layers: RNN / LSTM / GRU.
+
+Reference: ``python/mxnet/gluon/rnn/rnn_layer.py`` over the fused RNN op
+(``src/operator/rnn.cc`` + cuDNN rnn — SURVEY.md §3.2 "RNN"): multi-layer,
+optionally bidirectional, whole-sequence in one kernel.
+
+TPU-native: the time loop is ``lax.scan`` inside one pure function — XLA
+compiles the scanned cell into a single fused loop (what the reference needed
+cuDNN's monolithic kernel for).  The input projection for ALL timesteps is
+batched into one (T·N, in) × (in, G·nh) matmul per layer/direction so the MXU
+sees large GEMMs; only the recurrent h2h matmul stays inside the scan.  The
+whole computation lands on the autograd tape as one node (apply_fn), giving
+fused backward exactly like the reference's stateful RNN op.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from ...ndarray.ndarray import apply_fn
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, mode, gates,
+                 activation=None, **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout}")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = gates
+        self._activation = activation
+        ng, ni, nh = gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in (["l", "r"] if bidirectional else ["l"]):
+                setattr(self, f"{j}{i}_i2h_weight",
+                        self.params.get(f"{j}{i}_i2h_weight", shape=(ng * nh, ni),
+                                        init=i2h_weight_initializer,
+                                        allow_deferred_init=True))
+                setattr(self, f"{j}{i}_h2h_weight",
+                        self.params.get(f"{j}{i}_h2h_weight", shape=(ng * nh, nh),
+                                        init=h2h_weight_initializer,
+                                        allow_deferred_init=True))
+                setattr(self, f"{j}{i}_i2h_bias",
+                        self.params.get(f"{j}{i}_i2h_bias", shape=(ng * nh,),
+                                        init=i2h_bias_initializer,
+                                        allow_deferred_init=True))
+                setattr(self, f"{j}{i}_h2h_bias",
+                        self.params.get(f"{j}{i}_h2h_bias", shape=(ng * nh,),
+                                        init=h2h_bias_initializer,
+                                        allow_deferred_init=True))
+            ni = nh * self._dir
+
+    @property
+    def _num_states(self):
+        return 2 if self._mode == "lstm" else 1
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape}] * self._num_states
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+
+        return [F.zeros(info["shape"]) for info in self.state_info(batch_size)]
+
+    def infer_shape(self, x, *args):
+        ni = x.shape[-1]
+        for i in range(self._num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                p = getattr(self, f"{j}{i}_i2h_weight")
+                p.shape = (self._gates * self._hidden_size, ni)
+            ni = self._hidden_size * self._dir
+
+    # -- pure scan kernel --------------------------------------------------
+    def _scan_one_direction(self, jnp, jax, xs, h0, c0, wi, wh, bi, bh):
+        """xs: (T, N, ni). Returns (hs (T,N,nh), h_final, c_final|None)."""
+        from jax import nn as jnn
+
+        mode = self._mode
+        i2h_all = jnp.einsum("tni,gi->tng", xs, wi) + bi
+
+        if mode == "lstm":
+            def step(carry, i2h_t):
+                h_prev, c_prev = carry
+                gates = i2h_t + h_prev @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = jnn.sigmoid(i), jnn.sigmoid(f), jnn.sigmoid(o)
+                c = f * c_prev + i * jnp.tanh(g)
+                h = o * jnp.tanh(c)
+                return (h, c), h
+
+            (hf, cf), hs = jax.lax.scan(step, (h0, c0), i2h_all)
+            return hs, hf, cf
+        if mode == "gru":
+            def step(h_prev, i2h_t):
+                h2h = h_prev @ wh.T + bh
+                ir, iz, in_ = jnp.split(i2h_t, 3, axis=-1)
+                hr, hz, hn = jnp.split(h2h, 3, axis=-1)
+                r = jnn.sigmoid(ir + hr)
+                z = jnn.sigmoid(iz + hz)
+                n = jnp.tanh(in_ + r * hn)
+                h = (1 - z) * n + z * h_prev
+                return h, h
+
+            hf, hs = jax.lax.scan(step, h0, i2h_all)
+            return hs, hf, None
+        act = (lambda v: jnp.maximum(v, 0)) if self._activation == "relu" \
+            else jnp.tanh
+
+        def step(h_prev, i2h_t):
+            h = act(i2h_t + h_prev @ wh.T + bh)
+            return h, h
+
+        hf, hs = jax.lax.scan(step, h0, i2h_all)
+        return hs, hf, None
+
+    def _rnn_pure(self, names, n_states, training, rng_key, xv, *rest):
+        """Pure function: (x, *params, *states) -> (out, h_out[, c_out])."""
+        import jax
+        import jax.numpy as jnp
+
+        pv = dict(zip(names, rest[:len(names)]))
+        svals = list(rest[len(names):])
+        if self._layout == "NTC":
+            xv = jnp.swapaxes(xv, 0, 1)
+        T, N, _ = xv.shape
+        nh, nl, nd = self._hidden_size, self._num_layers, self._dir
+        if not svals:
+            svals = [jnp.zeros((nl * nd, N, nh), xv.dtype)
+                     for _ in range(n_states)]
+        out = xv
+        out_h, out_c = [], []
+        for layer in range(nl):
+            layer_outs = []
+            for d, tag in enumerate(["l", "r"][:nd]):
+                idx = layer * nd + d
+                seq = out if d == 0 else jnp.flip(out, axis=0)
+                h0 = svals[0][idx]
+                c0 = svals[1][idx] if self._mode == "lstm" else None
+                hs, hf, cf = self._scan_one_direction(
+                    jnp, jax, seq, h0, c0,
+                    pv[f"{tag}{layer}_i2h_weight"], pv[f"{tag}{layer}_h2h_weight"],
+                    pv[f"{tag}{layer}_i2h_bias"], pv[f"{tag}{layer}_h2h_bias"])
+                if d == 1:
+                    hs = jnp.flip(hs, axis=0)
+                layer_outs.append(hs)
+                out_h.append(hf)
+                if cf is not None:
+                    out_c.append(cf)
+            out = layer_outs[0] if nd == 1 else \
+                jnp.concatenate(layer_outs, axis=-1)
+            if self._dropout > 0 and layer < nl - 1 and training:
+                from jax import random as jr
+
+                keep = 1.0 - self._dropout
+                key = jr.fold_in(rng_key, layer)
+                out = out * jr.bernoulli(key, keep, out.shape
+                                         ).astype(out.dtype) / keep
+        if self._layout == "NTC":
+            out = jnp.swapaxes(out, 0, 1)
+        outs = (out, jnp.stack(out_h, axis=0))
+        if self._mode == "lstm":
+            outs = outs + (jnp.stack(out_c, axis=0),)
+        return outs
+
+    def forward(self, x, states=None):
+        from ... import autograd, random as _rnd
+
+        params = self._resolve_params(x)
+        names = sorted(params)
+        state_args = list(states) if states is not None else []
+        n_states = self._num_states
+        training = autograd.is_training()
+        rng_key = _rnd._next_key() if self._dropout > 0 else None
+
+        def fn(xv, *rest):
+            return self._rnn_pure(names, n_states, training, rng_key, xv, *rest)
+
+        outs = apply_fn(fn, [x] + [params[n] for n in names] + state_args,
+                        name=f"rnn:{self._mode}")
+        out = outs[0]
+        if states is None:
+            return out
+        return out, list(outs[1:])
+
+    def hybrid_forward(self, F, x, states=None, **params):
+        # used when traced inside an enclosing hybridized block
+        return self.forward(x, states)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._hidden_size}, "
+                f"num_layers={self._num_layers}, "
+                f"bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn", 1,
+                         activation=activation, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", 4, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", 3, **kwargs)

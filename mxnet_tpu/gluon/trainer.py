@@ -1,0 +1,166 @@
+"""Gluon Trainer: parameter ↔ kvstore ↔ optimizer wiring.
+
+Reference: ``python/mxnet/gluon/trainer.py`` (~500 LoC: Trainer.step =
+_allreduce_grads + _update, the _init_kvstore decision table for
+update_on_kvstore — SURVEY.md §3.5, §4.2).
+
+TPU-native: on a single host the per-param "grad ready → reduce" overlap the
+reference gets from engine dependencies comes free from jax async dispatch;
+multi-host reduction goes through the ``dist_tpu_sync`` KVStore (one psum per
+bucket).  For fully-sharded training use parallel.data_parallel's jit step
+instead — Trainer remains the imperative-compatible surface.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from .. import kvstore as kvs_mod
+from .parameter import ParameterDict, Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a ParameterDict/dict/list")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p}")
+            self._params.append(p)
+            self._param2idx[p.name] = i
+        self._compression_params = compression_params
+        self._contains_sparse = False
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_params = {"kvstore": kvstore,
+                                "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params:
+                raise MXNetError("optimizer_params must be None when optimizer "
+                                 "is an Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        """The update_on_kvstore decision (reference decision table:
+        dist + not sparse -> update on kvstore unless told otherwise)."""
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        if kvstore is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            kv = kvstore if isinstance(kvstore, kvs_mod.KVStore) else \
+                kvs_mod.create(kvstore)
+            self._kvstore = kv
+            if update_on_kvstore is None:
+                update_on_kvstore = "dist" in kv.type
+            self._update_on_kvstore = update_on_kvstore
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            for i, p in enumerate(self._params):
+                if p._data is not None:
+                    kv.init(i, p.data())
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+                # server-side updater owns the optimizer now
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr if self._optimizer.lr_scheduler is None else \
+            self._optimizer.lr_scheduler(self._optimizer.num_update)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+        if self._update_on_kvstore and self._kvstore is not None and \
+                self._kvstore._optimizer is not None:
+            self._kvstore._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce grads then update (reference: Trainer.step, §4.2)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        if self._kvstore is not None and self._kvstore._optimizer is not None:
+            self._kvstore._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            if self._update_on_kvstore:
+                # push grads; server applies optimizer; pull weights back
+                self._kvstore.push(i, param.list_grad())
+                self._kvstore.pull(i, param.list_data())
+            else:
+                self._kvstore.push(i, param.list_grad())
+                self._kvstore.pull(i, param.list_grad())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore and self._kvstore is not None:
+            # weights were already updated server-side during _allreduce_grads
+            return
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            for w, g in zip(param.list_data(), param.list_grad()):
+                updater(i, g, w)
+
+    def save_states(self, fname):
+        """Reference: Trainer.save_states (optimizer state round-trip)."""
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                self._updaters[0].set_states(f.read())
+            self._optimizer = self._updaters[0].optimizer
+        self._optimizer.param_dict = {i: p for i, p in enumerate(self._params)}

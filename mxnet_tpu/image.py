@@ -1,0 +1,128 @@
+"""Image utilities (reference: python/mxnet/image/image.py — imread,
+imresize, fixed/random crop, color normalize, ImageIter)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["imread", "imresize", "resize_short", "fixed_crop", "center_crop",
+           "random_crop", "color_normalize", "ImageIter"]
+
+
+def imread(filename, flag=1, to_rgb=True):
+    if filename.endswith(".npy"):
+        return array(_np.load(filename))
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise MXNetError("imread of encoded images needs PIL; .npy works "
+                         "without it") from e
+    img = _np.asarray(Image.open(filename))
+    if flag == 0 and img.ndim == 3:
+        img = img.mean(axis=-1, keepdims=True).astype(img.dtype)
+    return array(img)
+
+
+def imresize(src, w, h, interp=1):
+    import jax
+    import jax.numpy as jnp
+
+    v = src._get() if isinstance(src, NDArray) else jnp.asarray(_np.asarray(src))
+    out = jax.image.resize(v.astype(jnp.float32), (h, w, v.shape[2]),
+                           method="bilinear" if interp else "nearest")
+    return NDArray._from_jax(out.astype(v.dtype), getattr(src, "context", None))
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, size * h // w
+    else:
+        new_w, new_h = size * w // h, size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _np.random.randint(0, w - new_w + 1)
+    y0 = _np.random.randint(0, h - new_h + 1)
+    return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src if isinstance(src, NDArray) else array(src)
+    out = src - (mean if isinstance(mean, NDArray) else array(_np.asarray(mean)))
+    if std is not None:
+        out = out / (std if isinstance(std, NDArray) else array(_np.asarray(std)))
+    return out
+
+
+class ImageIter:
+    """Python-side image iterator over .rec or image list (reference:
+    mx.image.ImageIter).  Minimal: rec-file batching with resize/crop."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None, shuffle=False,
+                 aug_list=None, **kwargs):
+        from .recordio import MXIndexedRecordIO, unpack_img
+
+        if path_imgrec is None:
+            raise MXNetError("ImageIter requires path_imgrec here")
+        idx = path_imgrec[:path_imgrec.rfind(".")] + ".idx"
+        self._rec = MXIndexedRecordIO(idx, path_imgrec, "r")
+        self.batch_size = batch_size
+        self.data_shape = data_shape
+        self.shuffle = shuffle
+        self._unpack_img = unpack_img
+        self._order = list(self._rec.keys)
+        self._pos = 0
+        self.reset()
+
+    def reset(self):
+        self._pos = 0
+        if self.shuffle:
+            _np.random.shuffle(self._order)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        from .io import DataBatch
+
+        if self._pos + self.batch_size > len(self._order):
+            raise StopIteration
+        c, h, w = self.data_shape
+        data = _np.zeros((self.batch_size, c, h, w), dtype=_np.float32)
+        label = _np.zeros((self.batch_size,), dtype=_np.float32)
+        for i in range(self.batch_size):
+            rec = self._rec.read_idx(self._order[self._pos + i])
+            hdr, img = self._unpack_img(rec)
+            img = _np.asarray(imresize(array(img), w, h).asnumpy())
+            if img.ndim == 2:
+                img = img[:, :, None]
+            data[i] = img.transpose(2, 0, 1)[:c]
+            label[i] = hdr.label if _np.isscalar(hdr.label) else hdr.label[0]
+        self._pos += self.batch_size
+        return DataBatch(data=[array(data)], label=[array(label)])

@@ -1,0 +1,244 @@
+"""Weight initializers.
+
+Reference: ``python/mxnet/initializer.py`` (Xavier, MSRAPrelu, Uniform,
+Normal, Orthogonal, Bilinear, Constant, Mixed, registry + name-pattern
+dispatch).  Draws use the global RNG (mxnet_tpu.random).
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as _np
+
+from .base import Registry
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "Mixed", "register", "create"]
+
+_REG = Registry("initializer")
+
+
+def register(cls):
+    _REG.register(cls)
+    return cls
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _REG.create(name, **kwargs)
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint (reference: mxnet.initializer.InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        s = super().__new__(cls, name)
+        s.attrs = attrs or {}
+        s.global_init = global_init
+        return s
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            desc = str(desc)
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_zero(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_one(desc, arr)
+        elif name.endswith("beta"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def init_weight(self, name, arr):
+        self._init_weight(name, arr)
+
+    def _init_zero(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+
+def _rand_uniform(shape, scale, dtype):
+    from . import random as _rnd
+    from jax import random as jr
+
+    return jr.uniform(_rnd._next_key(), shape, minval=-scale, maxval=scale
+                      ).astype(dtype)
+
+
+def _rand_normal(shape, sigma, dtype):
+    from . import random as _rnd
+    from jax import random as jr
+
+    return jr.normal(_rnd._next_key(), shape).astype(dtype) * sigma
+
+
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+
+
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 1.0
+
+
+_REG.register(Zero, aliases=("zeros",))
+_REG.register(One, aliases=("ones",))
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr._set(_rand_uniform(arr.shape, self.scale, arr.dtype))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr._set(_rand_normal(arr.shape, self.sigma, arr.dtype))
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        flat = (shape[0], int(_np.prod(shape[1:])) if len(shape) > 1 else 1)
+        a = _np.random.normal(0.0, 1.0, flat)
+        u, _, vt = _np.linalg.svd(a, full_matrices=False)
+        q = u if u.shape == flat else vt
+        arr[:] = (self.scale * q.reshape(shape)).astype(arr.dtype)
+
+
+def _fan(shape):
+    hw = int(_np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[1] * hw if len(shape) > 1 else shape[0]
+    fan_out = shape[0] * hw
+    return fan_in, fan_out
+
+
+@register
+class Xavier(Initializer):
+    """Reference: mxnet.initializer.Xavier (gaussian/uniform, avg/in/out)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        fan_in, fan_out = _fan(arr.shape)
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        else:
+            factor = fan_out
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            arr._set(_rand_uniform(arr.shape, scale, arr.dtype))
+        else:
+            arr._set(_rand_normal(arr.shape, scale, arr.dtype))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__(rnd_type="gaussian", factor_type=factor_type,
+                         magnitude=magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        weight = _np.zeros(arr.shape, dtype="float32")
+        shape = arr.shape
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight
+
+
+@register
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+        n = arr.shape[0] // 4
+        a = arr.asnumpy()
+        a[n:2 * n] = self.forget_bias
+        arr[:] = a
+
+
+class Mixed:
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError(f"parameter {name} did not match any pattern")

@@ -1,0 +1,247 @@
+"""Legacy data iterators.
+
+Reference: ``python/mxnet/io/io.py`` (DataIter, DataBatch, NDArrayIter,
+ResizeIter, PrefetchingIter) over the C++ iterator registry in ``src/io/``
+(SURVEY.md §3.4).  The C++ threaded parser→batcher→prefetcher pipeline is
+replaced by the Gluon DataLoader's thread-pool prefetch; these classes keep
+the Module-era API surface.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        return []
+    if isinstance(data, (NDArray, _np.ndarray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}{'_' + str(i) if i else ''}": d
+                for i, d in enumerate(data)} if len(data) > 1 else \
+            ({default_name: data[0]} if data else {})
+    out = []
+    for k, v in data.items():
+        if not isinstance(v, NDArray):
+            v = array(_np.asarray(v))
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (reference: mx.io.NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.cursor = -batch_size
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.idx = _np.arange(self.num_data)
+        if shuffle:
+            _np.random.shuffle(self.idx)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "roll_over":
+            return self.cursor < self.num_data
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrays):
+        out = []
+        for _, v in arrays:
+            end = self.cursor + self.batch_size
+            ids = self.idx[self.cursor:min(end, self.num_data)]
+            batch = v.asnumpy()[ids]
+            if len(ids) < self.batch_size:  # pad
+                pad = self.batch_size - len(ids)
+                batch = _np.concatenate([batch, batch[:pad]])
+            out.append(array(batch))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if end > self.num_data:
+            return end - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize (truncate/loop) another iterator to size batches per epoch."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Thread-prefetch wrapper (reference: mx.io.PrefetchingIter over
+    dmlc::ThreadedIter)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import threading
+        import queue
+
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        if len(iters) != 1:
+            raise MXNetError("PrefetchingIter here supports one base iter")
+        self.iter = iters[0]
+        super().__init__(self.iter.batch_size)
+        self._queue = queue.Queue(maxsize=2)
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        import threading
+
+        def worker():
+            while True:
+                try:
+                    batch = self.iter.next()
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batch)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        while self._thread.is_alive():
+            try:
+                if self._queue.get(timeout=0.1) is None:
+                    break
+            except Exception:
+                break
+        self.iter.reset()
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    def iter_next(self):
+        raise NotImplementedError

@@ -1,0 +1,290 @@
+"""KVStore: the parameter-synchronization abstraction.
+
+Reference: ``src/kvstore/`` + ``python/mxnet/kvstore.py`` (SURVEY.md §3.3,
+§4.4): string-keyed Init/Push/Pull/PushPull with five flavors (local, device,
+nccl, dist_sync, dist_device_sync, dist_async) over CPU reducers, NCCL, and
+the ps-lite parameter server.
+
+TPU-native mapping (SURVEY.md §6.8, the north star's ``dist_tpu_sync``):
+
+- ``local`` / ``device`` / ``nccl``  →  single-process reduction: gradients
+  from N devices are summed with jax ops (XLA issues the device-to-device
+  copies; on a multi-chip host this is an ICI transfer exactly like the
+  reference's P2P/NCCL path).
+- ``dist_sync`` / ``dist_device_sync`` / ``dist_tpu_sync``  →  multi-host
+  data parallelism over a ``jax.sharding.Mesh``: push+pull lowers to one
+  ``psum`` over the 'dp' mesh axis (bucketed; rides ICI intra-slice, DCN
+  across slices).  The worker/server/scheduler triangle of ps-lite is
+  replaced by jax.distributed SPMD — see parallel/.
+- ``update_on_kvstore``: the server-side-optimizer semantics are provided by
+  attaching an optimizer via ``set_optimizer`` — locally the updater runs on
+  the reduced gradient once (instead of once per device), matching the
+  reference's semantics (§4.4 ApplyUpdates).
+
+The string API (create/init/push/pull/pushpull/set_optimizer/
+set_gradient_compression) is the compatibility surface and is kept intact.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as _np
+
+from .base import MXNetError, Registry
+from .ndarray.ndarray import NDArray, invoke
+from .ndarray import ndarray as _ndm
+
+__all__ = ["KVStore", "create"]
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class KVStore:
+    """Single-process KVStore covering local/device/nccl semantics."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- core API ----------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        """Reduce values (one per device) into the store buffer.
+        Reference: KVStoreLocal::PushImpl -> CommDevice::Reduce."""
+        keys, grouped = _group_key_value(key, value)
+        for k, vals in zip(keys, grouped):
+            reduced = _reduce(vals)
+            if self._compression is not None:
+                reduced = self._compression.round_trip(reduced, key=k)
+            if self._updater is not None:
+                # update_on_kvstore: apply optimizer to stored weight
+                self._updater(_key_int(k), reduced, self._store[k])
+            else:
+                self._store[k] = reduced
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast stored value to every output (≙ CommDevice::Broadcast)."""
+        keys, grouped = _group_key_value(key, out)
+        for k, outs in zip(keys, grouped):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            src = self._store[k]
+            for o in outs:
+                o._set(src.as_in_context(o.context)._get().astype(o._get().dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (reference: sparse embedding path).
+        Dense emulation: gather rows after a full pull."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        outs = _as_list(out)
+        rids = _as_list(row_ids)
+        src = self._store[key if not isinstance(key, (list, tuple)) else key[0]]
+        for o, r in zip(outs, rids):
+            rows = invoke("take", [src, r], {"axis": 0, "mode": "clip"})
+            o._set(rows._get().astype(o._get().dtype))
+
+    # -- optimizer attach ---------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Reference: kv.set_optimizer pickles the optimizer to the servers
+        (§4.4).  Locally: build the updater in-process."""
+        from . import optimizer as opt_mod
+
+        # round-trip through pickle to preserve reference semantics
+        optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        ctype = compression_params.get("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError(f"unknown gradient compression type {ctype}")
+        self._compression = TwoBitCompression(
+            float(compression_params.get("threshold", 0.5)))
+
+    # -- optimizer state io --------------------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no updater attached")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no updater attached")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        _ndm.waitall()
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+class TwoBitCompression:
+    """2-bit stochastic gradient quantization with error-feedback residual.
+
+    Reference: ``src/kvstore/gradient_compression.{cc,cu}`` (SURVEY.md §3.3).
+    TPU-native: pure jax quantize/dequantize, XLA-fused; the residual rides
+    in host state per key.
+    """
+
+    def __init__(self, threshold=0.5):
+        self.threshold = threshold
+        self._residuals = {}
+
+    def round_trip(self, grad_nd, key=None):
+        import jax.numpy as jnp
+
+        g = grad_nd._get()
+        r = self._residuals.get(key)
+        if r is not None:
+            g = g + r
+        t = self.threshold
+        q = jnp.where(g >= t, t, jnp.where(g <= -t, -t, 0.0)).astype(g.dtype)
+        self._residuals[key] = g - q
+        return NDArray._from_jax(q, grad_nd.context)
+
+
+class DistTPUSyncKVStore(KVStore):
+    """``dist_tpu_sync``: multi-host data parallelism via mesh collectives.
+
+    In a multi-process jax.distributed job every worker holds its local
+    shard's gradients; push+pull is one bucketed psum over the 'dp' axis of
+    the global mesh (parallel.mesh.get_default_mesh()).  In a single-process
+    session it degrades to local semantics (matching how the reference's
+    dist kvstore behaves with one worker).
+    """
+
+    def __init__(self, kind="dist_tpu_sync"):
+        super().__init__(kind)
+        self._mesh = None
+
+    @property
+    def rank(self):
+        import jax
+
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        import jax
+
+        return jax.process_count()
+
+    def _get_mesh(self):
+        if self._mesh is None:
+            from .parallel import mesh as _mesh
+
+            self._mesh = _mesh.get_default_mesh()
+        return self._mesh
+
+    def push(self, key, value, priority=0):
+        keys, grouped = _group_key_value(key, value)
+        for k, vals in zip(keys, grouped):
+            reduced = _reduce(vals)
+            if self.num_workers > 1:
+                reduced = self._allreduce(reduced)
+            if self._compression is not None:
+                reduced = self._compression.round_trip(reduced, key=k)
+            if self._updater is not None:
+                self._updater(_key_int(k), reduced, self._store[k])
+            else:
+                self._store[k] = reduced
+
+    def _allreduce(self, nd):
+        """Cross-host allreduce: jax makes a global array over the dp mesh
+        and psums it (rides ICI within a slice, DCN across slices)."""
+        from .parallel.collectives import allreduce_hosts
+
+        return NDArray._from_jax(allreduce_hosts(nd._get()), nd.context)
+
+
+_KVSTORE_REG = Registry("kvstore")
+for _name in ("local", "device", "nccl", "local_allreduce_cpu",
+              "local_allreduce_device"):
+    _KVSTORE_REG.register(KVStore, name=_name)
+for _name in ("dist_sync", "dist_device_sync", "dist_async", "dist_tpu_sync",
+              "dist_sync_device", "dist"):
+    _KVSTORE_REG.register(DistTPUSyncKVStore, name=_name)
+
+
+def create(name="local"):
+    """Create a KVStore by type string (reference: KVStore::Create,
+    src/kvstore/kvstore.cc)."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    store = _KVSTORE_REG.create(name, name)
+    return store
+
+
+# --------------------------------------------------------------------------
+def _key_value(key, value):
+    keys = _as_list(key)
+    values = _as_list(value)
+    if len(keys) == 1 and len(values) > 1:
+        raise MXNetError("single key with multiple values: use push for "
+                         "multi-device values")
+    return [str(k) for k in keys], values
+
+
+def _group_key_value(key, value):
+    """Group (possibly multi-device) values per key (reference:
+    KVStoreLocal::GroupKVPairs)."""
+    keys = [str(k) for k in _as_list(key)]
+    values = _as_list(value)
+    if len(keys) == 1:
+        return keys, [values]
+    if len(values) == len(keys):
+        return keys, [[v] if not isinstance(v, (list, tuple)) else list(v)
+                      for v in values]
+    if len(values) % len(keys) == 0:
+        per = len(values) // len(keys)
+        return keys, [values[i * per:(i + 1) * per] for i in range(len(keys))]
+    raise MXNetError("cannot group keys with values")
+
+
+def _reduce(vals):
+    if len(vals) == 1:
+        return vals[0].copy()
+    ctx = vals[0].context
+    acc = vals[0]._get()
+    for v in vals[1:]:
+        acc = acc + v.as_in_context(ctx)._get()
+    return NDArray._from_jax(acc, ctx)
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k

@@ -1,0 +1,60 @@
+"""Monitor: per-step tensor statistics tap (reference:
+python/mxnet/monitor.py — stat_func over outputs/weights, regex-filtered)."""
+from __future__ import annotations
+
+import re
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return x.norm() / (x.size ** 0.5)
+        self.stat_func = stat_func
+        self.interval = interval
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, block):
+        """Attach forward hooks to a Gluon block tree."""
+
+        def hook(blk, inputs, output):
+            if not self.activated:
+                return
+            name = blk.name
+            outs = output if isinstance(output, (list, tuple)) else [output]
+            for i, o in enumerate(outs):
+                key = f"{name}_output{i}"
+                if self.re_pattern.match(key) and isinstance(o, NDArray):
+                    self.queue.append((self.step, key, self.stat_func(o)))
+
+        block.apply(lambda b: b.register_forward_hook(hook))
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for n, k, v_nd in self.queue:
+            res.append((n, k, str(v_nd.asnumpy())))
+        self.queue = []
+        self.step += 1
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        return res
+
+    def toc_print(self):
+        for n, k, v in self.toc():
+            print(f"Batch: {n:7d} {k:30s} {v}")

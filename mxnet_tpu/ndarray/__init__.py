@@ -1,0 +1,137 @@
+"""The ``mx.nd`` namespace: functions code-generated from the op table.
+
+Reference: ``python/mxnet/ndarray/register.py`` + ``gen_op`` codegen at
+import time from the C op registry (SURVEY.md §3.5 "base/ctypes layer").
+Here the registry is ``mxnet_tpu.ops.registry.OP_TABLE``; each op becomes a
+module-level function that unwraps NDArrays, dispatches the pure jax fn
+(async, ≙ engine push) and wraps results.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+import numpy as _np
+
+from .. import ops as _ops  # noqa: F401  (populates the table)
+from ..ops.registry import OP_TABLE, list_ops
+from ..context import current_context
+from .ndarray import NDArray, array, invoke, waitall, concatenate
+
+__all__ = ["NDArray", "array", "invoke", "waitall", "zeros", "ones", "full",
+           "arange", "empty", "concat", "concatenate", "list_ops", "save", "load"]
+
+
+def _make_op_func(opname, od):
+    """Positional array inputs map to the op's array params; positional
+    scalars/tuples bind (in order) to the op's defaulted attr params —
+    mirroring the reference's codegen'd signatures."""
+    import inspect
+
+    fn_params = list(inspect.signature(od.fn).parameters.values())
+    if od.needs_rng:
+        fn_params = fn_params[1:]  # skip the PRNG key param
+    attr_names = [p.name for p in fn_params
+                  if p.default is not inspect.Parameter.empty]
+
+    def fn(*args, out=None, ctx=None, name=None, **attrs):
+        nd_args = []
+        extra = []
+        for a in args:
+            if isinstance(a, NDArray) or isinstance(a, _np.ndarray) or \
+                    (hasattr(a, "shape") and hasattr(a, "dtype")):
+                nd_args.append(a if isinstance(a, NDArray) else array(a, ctx=ctx))
+            else:
+                extra.append(a)
+        ai = 0
+        for v in extra:
+            while ai < len(attr_names) and attr_names[ai] in attrs:
+                ai += 1
+            if ai >= len(attr_names):
+                raise TypeError(f"{opname}: too many positional arguments")
+            attrs[attr_names[ai]] = v
+            ai += 1
+        return invoke(opname, nd_args, attrs, out=out, ctx=ctx)
+
+    fn.__name__ = opname
+    fn.__qualname__ = opname
+    fn.__doc__ = od.fn.__doc__ or f"Operator {opname} (see mxnet_tpu.ops)"
+    return fn
+
+
+_mod = _sys.modules[__name__]
+for _name in list(OP_TABLE):
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_op_func(_name, OP_TABLE[_name]))
+
+
+# -- convenience overrides with MXNet positional signatures ----------------
+def zeros(shape, ctx=None, dtype="float32", **kw):
+    return invoke("zeros", [], {"shape": _shape_t(shape), "dtype": dtype}, ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype="float32", **kw):
+    return invoke("ones", [], {"shape": _shape_t(shape), "dtype": dtype}, ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32", **kw):
+    return invoke("full", [], {"shape": _shape_t(shape), "val": val, "dtype": dtype},
+                  ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    return invoke("arange", [], {"start": start, "stop": stop, "step": step,
+                                 "repeat": repeat, "dtype": dtype}, ctx=ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return invoke("eye", [], {"N": N, "M": M, "k": k, "dtype": dtype}, ctx=ctx)
+
+
+def concat(*arrays, dim=1, **kw):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    return invoke("concat", list(arrays), {"dim": dim})
+
+
+def stack(*arrays, axis=0, **kw):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    return invoke("stack", list(arrays), {"axis": axis})
+
+
+def add_n(*arrays, **kw):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    return invoke("add_n", list(arrays), {})
+
+
+def zeros_like(a, **kw):
+    return invoke("zeros_like", [a], {})
+
+
+def ones_like(a, **kw):
+    return invoke("ones_like", [a], {})
+
+
+def _shape_t(shape):
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def save(fname, data):
+    from .serialization import save as _save
+
+    return _save(fname, data)
+
+
+def load(fname):
+    from .serialization import load as _load
+
+    return _load(fname)
+
+
+# random namespace: mx.nd.random.uniform(...)
+from . import random  # noqa: E402,F401

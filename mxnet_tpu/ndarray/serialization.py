@@ -1,0 +1,111 @@
+"""NDArray save/load in the legacy ``.params`` TLV container.
+
+Reference: ``MXNDArraySave/Load`` — a dmlc::Stream TLV container of named
+arrays (src/ndarray/ndarray.cc save/load section; SURVEY.md §6.4).  Layout
+implemented here (verify byte-level fidelity against the reference when the
+mount is populated — SURVEY.md §9.8):
+
+    uint64  kMXAPINDArrayListMagic = 0x112
+    uint64  reserved = 0
+    uint64  ndarray count N
+    N x NDArray records:
+        uint32  NDARRAY_V2_MAGIC = 0xF993FAC9
+        uint32  reserved (stype = -1 dense)
+        uint32  ndim
+        uint32  shape[ndim]  (int64 each in V3; V2 uses uint32 — we write V2)
+        uint32  context.dev_type, int32 context.dev_id
+        int32   type_flag (mshadow enum)
+        raw     data bytes (C order)
+    uint64  name count (N or 0)
+    N x (uint64 len, bytes) names
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array as _nd_array
+
+_LIST_MAGIC = 0x112
+_ND_MAGIC = 0xF993FAC9
+
+# mshadow type flags (reference: mshadow/base.h TypeFlag)
+_TYPE_FLAG = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+              "int32": 4, "int8": 5, "int64": 6, "bool": 7, "bfloat16": 12}
+_FLAG_TYPE = {v: k for k, v in _TYPE_FLAG.items()}
+
+
+def _dtype_name(dt):
+    s = str(dt)
+    return {"<f4": "float32"}.get(s, s)
+
+
+def save(fname, data):
+    """Save NDArrays: dict[str, NDArray], list[NDArray], or single NDArray."""
+    if isinstance(data, NDArray):
+        names, arrays = [], [data]
+    elif isinstance(data, (list, tuple)):
+        names, arrays = [], list(data)
+    elif isinstance(data, dict):
+        names, arrays = list(data.keys()), list(data.values())
+    else:
+        raise MXNetError(f"cannot save {type(data)}")
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQQ", _LIST_MAGIC, 0, len(arrays)))
+        for arr in arrays:
+            np_arr = _np.ascontiguousarray(arr.asnumpy() if isinstance(arr, NDArray)
+                                           else _np.asarray(arr))
+            dt = _dtype_name(np_arr.dtype.name if hasattr(np_arr.dtype, "name")
+                             else np_arr.dtype)
+            if dt not in _TYPE_FLAG:
+                # bfloat16 comes through as 'bfloat16' via ml_dtypes
+                raise MXNetError(f"unsupported dtype {dt}")
+            f.write(struct.pack("<II", _ND_MAGIC, 0xFFFFFFFF))
+            f.write(struct.pack("<I", np_arr.ndim))
+            f.write(struct.pack(f"<{np_arr.ndim}I", *np_arr.shape) if np_arr.ndim else b"")
+            f.write(struct.pack("<Ii", 1, 0))  # cpu context
+            f.write(struct.pack("<i", _TYPE_FLAG[dt]))
+            f.write(np_arr.tobytes())
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save`. Returns dict (if named) or list."""
+    with open(fname, "rb") as f:
+        magic, _res, count = struct.unpack("<QQQ", f.read(24))
+        if magic != _LIST_MAGIC:
+            raise MXNetError(f"invalid .params file {fname} (magic {magic:#x})")
+        arrays = []
+        for _ in range(count):
+            nd_magic, stype = struct.unpack("<II", f.read(8))
+            if nd_magic != _ND_MAGIC:
+                raise MXNetError("corrupt NDArray record")
+            (ndim,) = struct.unpack("<I", f.read(4))
+            shape = struct.unpack(f"<{ndim}I", f.read(4 * ndim)) if ndim else ()
+            _devt, _devid = struct.unpack("<Ii", f.read(8))
+            (tflag,) = struct.unpack("<i", f.read(4))
+            dt = _FLAG_TYPE[tflag]
+            if dt == "bfloat16":
+                import ml_dtypes
+
+                np_dt = _np.dtype(ml_dtypes.bfloat16)
+            else:
+                np_dt = _np.dtype(dt)
+            nbytes = int(_np.prod(shape)) * np_dt.itemsize if shape else np_dt.itemsize
+            buf = f.read(nbytes)
+            np_arr = _np.frombuffer(buf, dtype=np_dt).reshape(shape)
+            arrays.append(_nd_array(np_arr, dtype=np_dt))
+        (n_names,) = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(n_names):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    if names:
+        return dict(zip(names, arrays))
+    return arrays

@@ -1,0 +1,8 @@
+"""Operator table population.  Importing this package registers every op
+family (reference: static registration of NNVM_REGISTER_OP at library load,
+SURVEY.md §3.2)."""
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from .registry import OP_TABLE, get_op, list_ops, register  # noqa: F401

@@ -1,0 +1,544 @@
+"""Neural-net operator family.
+
+Reference: ``src/operator/nn/*.{cc,cu,h}`` (+ cuDNN/MKLDNN variants, ~60k LoC
+— SURVEY.md §3.2 "Dense NN ops").  TPU-native: convolutions and matmuls lower
+via ``lax.conv_general_dilated`` / ``dot_general`` straight onto the MXU; the
+cuDNN-autotune/MKLDNN-layout machinery has no analog because XLA's layout
+assignment owns that decision.  API keeps MXNet's NCHW default layout; XLA
+relayouts internally for the TPU.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _lax():
+    from jax import lax
+
+    return lax
+
+
+def _nn():
+    from jax import nn
+
+    return nn
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    return t if len(t) == n else t + t[-1:] * (n - len(t))
+
+
+# ==========================================================================
+# FullyConnected (reference: src/operator/nn/fully_connected.cc)
+# ==========================================================================
+@register("FullyConnected", aliases=("fully_connected",))
+def fully_connected(x, weight, *maybe_bias, num_hidden=None, no_bias=False,
+                    flatten=True):
+    jnp = _jnp()
+    if flatten:
+        x2 = x.reshape((x.shape[0], -1))
+    else:
+        x2 = x
+    # weight layout: (num_hidden, in_units) — matches reference
+    y = jnp.matmul(x2, weight.T)
+    if not no_bias and maybe_bias:
+        y = y + maybe_bias[0]
+    return y
+
+
+# ==========================================================================
+# Convolution / Deconvolution (reference: src/operator/nn/convolution.cc)
+# ==========================================================================
+def _conv_dimnums(ndim, layout):
+    if ndim == 3:  # NCW
+        return ("NCH", "OIH", "NCH")
+    if ndim == 4:
+        if layout in (None, "NCHW"):
+            return ("NCHW", "OIHW", "NCHW")
+        return ("NHWC", "HWIO", "NHWC")
+    if ndim == 5:
+        return ("NCDHW", "OIDHW", "NCDHW")
+    raise ValueError(f"conv input ndim {ndim} unsupported")
+
+
+@register("Convolution", aliases=("convolution",))
+def convolution(x, weight, *maybe_bias, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None, cudnn_tune=None, cudnn_off=None, workspace=None):
+    lax = _lax()
+    nd = x.ndim - 2
+    strides = _tup(stride, nd)
+    dil = _tup(dilate, nd)
+    pads = _tup(pad, nd) if pad is not None else (0,) * nd
+    padding = [(p, p) for p in pads]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    _conv_dimnums(x.ndim, layout))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=strides, padding=padding,
+        rhs_dilation=dil, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=None)
+    if not no_bias and maybe_bias:
+        b = maybe_bias[0]
+        y = y + b.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def deconvolution(x, weight, *maybe_bias, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, num_filter=None, num_group=1,
+                  no_bias=True, layout=None, target_shape=None, workspace=None,
+                  cudnn_tune=None, cudnn_off=None):
+    lax = _lax()
+    jnp = _jnp()
+    nd = x.ndim - 2
+    strides = _tup(stride, nd)
+    pads = _tup(pad, nd) if pad is not None else (0,) * nd
+    dil = _tup(dilate, nd)
+    # weight layout (in_ch, out_ch/g, *k) like the reference; conv_transpose
+    # wants IOHW-style via dimension numbers
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "IOHW", "NCHW") if x.ndim == 4 else ("NCH", "IOH", "NCH"))
+    padding = [(d * (k - 1) - p, d * (k - 1) - p)
+               for k, p, d in zip(weight.shape[2:], pads, dil)]
+    y = lax.conv_transpose(x, weight, strides=strides, padding=padding,
+                           rhs_dilation=dil, dimension_numbers=dn,
+                           transpose_kernel=True)
+    if not no_bias and maybe_bias:
+        y = y + maybe_bias[0].reshape((1, -1) + (1,) * nd)
+    return y
+
+
+# ==========================================================================
+# Pooling (reference: src/operator/nn/pooling.cc)
+# ==========================================================================
+@register("Pooling", aliases=("pooling",))
+def pooling(x, kernel=None, pool_type="max", stride=None, pad=None,
+            global_pool=False, pooling_convention="valid", count_include_pad=True,
+            cudnn_off=None, layout=None):
+    lax = _lax()
+    jnp = _jnp()
+    nd = x.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, x.ndim))
+        if pool_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        return jnp.mean(x, axis=axes, keepdims=True)
+    k = _tup(kernel, nd)
+    s = _tup(stride if stride is not None else 1, nd)
+    p = _tup(pad or 0, nd)
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    padding = ((0, 0), (0, 0)) + tuple((pp, pp) for pp in p)
+    if pooling_convention == "full":
+        # ceil-mode: extend padding on the high side so ceil division is covered
+        extra = []
+        for i in range(nd):
+            in_sz = x.shape[2 + i] + 2 * p[i]
+            rem = (in_sz - k[i]) % s[i]
+            extra.append(0 if rem == 0 else s[i] - rem)
+        padding = ((0, 0), (0, 0)) + tuple((p[i], p[i] + extra[i]) for i in range(nd))
+    if pool_type == "max":
+        init = -_np.inf
+        y = lax.reduce_window(x, init, lax.max, window, strides, padding)
+        return y
+    if pool_type in ("avg", "sum"):
+        y = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return y
+        if count_include_pad:
+            denom = 1.0
+            for kk in k:
+                denom *= kk
+            return y / denom
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return y / cnt
+    if pool_type == "lp":
+        y = lax.reduce_window(jnp.abs(x) ** 2, 0.0, lax.add, window, strides, padding)
+        return jnp.sqrt(y)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@register("UpSampling", aliases=("upsampling",))
+def upsampling(x, *weights, scale=2, sample_type="nearest", num_filter=0,
+               multi_input_mode=None, num_args=1, workspace=None):
+    jnp = _jnp()
+    if sample_type == "nearest":
+        y = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        return y
+    # bilinear
+    import jax
+
+    n, c, h, w = x.shape
+    return jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
+
+
+@register("BilinearResize2D", aliases=("bilinear_resize2d",))
+def bilinear_resize2d(x, height=None, width=None, scale_height=None,
+                      scale_width=None, mode="size"):
+    import jax
+
+    n, c, h, w = x.shape
+    th = height if height else int(h * scale_height)
+    tw = width if width else int(w * scale_width)
+    return jax.image.resize(x, (n, c, th, tw), method="bilinear")
+
+
+# ==========================================================================
+# Activations (reference: src/operator/nn/activation.cc, leaky_relu.cc)
+# ==========================================================================
+@register("Activation", aliases=("activation",))
+def activation(x, act_type="relu"):
+    jnp = _jnp()
+    nn = _nn()
+    if act_type == "relu":
+        return jnp.maximum(x, 0)
+    if act_type == "sigmoid":
+        return nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return nn.softplus(x)
+    if act_type == "softsign":
+        return x / (1 + jnp.abs(x))
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register("LeakyReLU", aliases=("leaky_relu",))
+def leaky_relu(x, *maybe_gamma, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334):
+    jnp = _jnp()
+    nn = _nn()
+    if act_type == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act_type == "prelu":
+        gamma = maybe_gamma[0]
+        g = gamma.reshape((1, -1) + (1,) * (x.ndim - 2)) if x.ndim > 1 and gamma.ndim == 1 else gamma
+        return jnp.where(x > 0, x, g * x)
+    if act_type == "elu":
+        return jnp.where(x > 0, x, slope * (jnp.exp(x) - 1))
+    if act_type == "selu":
+        return nn.selu(x)
+    if act_type == "gelu":
+        return nn.gelu(x, approximate=False)
+    if act_type == "rrelu":  # eval-mode deterministic: mean slope
+        s = (lower_bound + upper_bound) / 2
+        return jnp.where(x > 0, x, s * x)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register("softmax")
+def softmax(x, axis=-1, temperature=None, length=None):
+    nn = _nn()
+    jnp = _jnp()
+    if temperature:
+        x = x / temperature
+    if length is not None:
+        steps = jnp.arange(x.shape[axis])
+        shp = [1] * x.ndim
+        shp[axis] = x.shape[axis]
+        mask = steps.reshape(shp) < length.reshape((-1,) + (1,) * (x.ndim - 1))
+        x = jnp.where(mask, x, -1e30)
+    return nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(x, axis=-1, temperature=None):
+    if temperature:
+        x = x / temperature
+    return _nn().log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def softmin(x, axis=-1):
+    return _nn().softmax(-x, axis=axis)
+
+
+@register("smooth_l1")
+def smooth_l1(x, scalar=1.0):
+    jnp = _jnp()
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * jnp.square(x),
+                     jnp.abs(x) - 0.5 / s2)
+
+
+# ==========================================================================
+# Normalization (reference: src/operator/nn/{batch_norm,layer_norm,...}.cc)
+# ==========================================================================
+@register("BatchNorm", aliases=("batch_norm",), nout=3)
+def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
+               fix_gamma=True, use_global_stats=False, axis=1,
+               cudnn_off=None, output_mean_var=False, training=False):
+    """Returns (out, new_moving_mean, new_moving_var).
+
+    The reference mutates the moving stats inside the op (stateful FCompute);
+    here the layer writes outputs 1-2 back into the running-stat parameters
+    (functional-state threading; under ``hybridize`` these ride as extra jit
+    outputs).
+    """
+    jnp = _jnp()
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    if training and not use_global_stats:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_mean = moving_mean * momentum + mean * (1 - momentum)
+        new_var = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    xh = (x - mean.reshape(shape)) * _lax().rsqrt(var.reshape(shape) + eps)
+    out = xh * g.reshape(shape) + beta.reshape(shape)
+    from jax import lax as _l
+
+    return out, _l.stop_gradient(new_mean), _l.stop_gradient(new_var)
+
+
+@register("LayerNorm", aliases=("layer_norm",))
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    jnp = _jnp()
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    xh = (x - mean) * _lax().rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return xh * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("GroupNorm", aliases=("group_norm",))
+def group_norm(x, gamma, beta, num_groups=1, eps=1e-5, output_mean_var=False):
+    jnp = _jnp()
+    n, c = x.shape[:2]
+    rest = x.shape[2:]
+    xg = x.reshape((n, num_groups, c // num_groups) + rest)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    xh = ((xg - mean) * _lax().rsqrt(var + eps)).reshape(x.shape)
+    shape = (1, c) + (1,) * len(rest)
+    return xh * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("InstanceNorm", aliases=("instance_norm",))
+def instance_norm(x, gamma, beta, eps=1e-3):
+    jnp = _jnp()
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    xh = (x - mean) * _lax().rsqrt(var + eps)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    return xh * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("L2Normalization", aliases=("l2_normalization",))
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    jnp = _jnp()
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, x.ndim))
+    nrm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / nrm
+
+
+@register("LRN", aliases=("lrn",))
+def lrn(x, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
+    jnp = _jnp()
+    sq = jnp.square(x)
+    half = nsize // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = jnp.zeros_like(x)
+    for i in range(nsize):
+        acc = acc + pad[:, i:i + x.shape[1]]
+    return x / jnp.power(knorm + alpha * acc / nsize, beta)
+
+
+# ==========================================================================
+# Dropout (reference: src/operator/nn/dropout.cc) — needs RNG key
+# ==========================================================================
+@register("Dropout", aliases=("dropout",), needs_rng=True)
+def dropout_op(key, x, p=0.5, mode="training", axes=None, training=False,
+               cudnn_off=None):
+    from jax import random as jr
+
+    jnp = _jnp()
+    if not training and mode != "always":
+        return x
+    if p <= 0.0:
+        return x
+    shape = x.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(x.shape))
+    keep = 1.0 - p
+    mask = jr.bernoulli(key, keep, shape).astype(x.dtype) / keep
+    return x * mask
+
+
+# ==========================================================================
+# Loss-layer ops (reference: src/operator/softmax_output.cc etc.)
+# ==========================================================================
+@register("SoftmaxOutput", aliases=("softmax_output", "SoftmaxActivation"))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
+                   use_ignore=False, multi_output=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Forward = softmax; backward = (p - onehot(label)) * grad_scale,
+    *ignoring* the incoming out_grad — a loss layer, exactly like the
+    reference (src/operator/softmax_output.cc).  Implemented with
+    jax.custom_vjp to pin that gradient."""
+    import jax
+
+    nn = _nn()
+    jnp = _jnp()
+
+    @jax.custom_vjp
+    def _so(d, l):
+        return nn.softmax(d, axis=-1)
+
+    def _fwd(d, l):
+        p = nn.softmax(d, axis=-1)
+        return p, (p, l)
+
+    def _bwd(res, g):
+        p, l = res
+        oh = nn.one_hot(l.astype(_np.int32), p.shape[-1], dtype=p.dtype)
+        grad = (p - oh)
+        if use_ignore:
+            mask = (l != ignore_label).astype(p.dtype)
+            grad = grad * mask[..., None]
+        if normalization == "batch":
+            grad = grad / p.shape[0]
+        elif normalization == "valid" and use_ignore:
+            cnt = jnp.maximum(jnp.sum(l != ignore_label), 1)
+            grad = grad / cnt
+        return grad * grad_scale, jnp.zeros_like(l)
+
+    _so.defvjp(_fwd, _bwd)
+    return _so(data, label)
+
+
+@register("MakeLoss", aliases=("make_loss",))
+def make_loss(x, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    import jax
+
+    @jax.custom_vjp
+    def _ml(v):
+        return v
+
+    def _fwd(v):
+        return v, v
+
+    def _bwd(res, g):
+        jnp = _jnp()
+        grad = jnp.ones_like(res) * grad_scale
+        if normalization == "batch":
+            grad = grad / res.shape[0]
+        return (grad,)
+
+    _ml.defvjp(_fwd, _bwd)
+    return _ml(x)
+
+
+@register("LinearRegressionOutput", aliases=("linear_regression_output",))
+def linear_regression_output(data, label, grad_scale=1.0):
+    import jax
+
+    @jax.custom_vjp
+    def _lr(d, l):
+        return d
+
+    def _fwd(d, l):
+        return d, (d, l)
+
+    def _bwd(res, g):
+        d, l = res
+        jnp = _jnp()
+        return ((d - l.reshape(d.shape)) * grad_scale / d.shape[0] * 1.0,
+                jnp.zeros_like(l))
+
+    _lr.defvjp(_fwd, _bwd)
+    return _lr(data, label)
+
+
+@register("LogisticRegressionOutput", aliases=("logistic_regression_output",))
+def logistic_regression_output(data, label, grad_scale=1.0):
+    import jax
+
+    nn = _nn()
+
+    @jax.custom_vjp
+    def _lr(d, l):
+        return nn.sigmoid(d)
+
+    def _fwd(d, l):
+        return nn.sigmoid(d), (nn.sigmoid(d), l)
+
+    def _bwd(res, g):
+        p, l = res
+        jnp = _jnp()
+        return ((p - l.reshape(p.shape)) * grad_scale, jnp.zeros_like(l))
+
+    _lr.defvjp(_fwd, _bwd)
+    return _lr(data, label)
+
+
+@register("MAERegressionOutput", aliases=("mae_regression_output",))
+def mae_regression_output(data, label, grad_scale=1.0):
+    import jax
+
+    @jax.custom_vjp
+    def _lr(d, l):
+        return d
+
+    def _fwd(d, l):
+        return d, (d, l)
+
+    def _bwd(res, g):
+        d, l = res
+        jnp = _jnp()
+        return (jnp.sign(d - l.reshape(d.shape)) * grad_scale, jnp.zeros_like(l))
+
+    _lr.defvjp(_fwd, _bwd)
+    return _lr(data, label)
+
+
+@register("CTCLoss", aliases=("ctc_loss",))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False, blank_label="first"):
+    """data: (seq, batch, alphabet). Reference: src/operator/nn/ctc_loss.cc.
+    TPU impl: optax.ctc_loss (blank must be 0 — 'first')."""
+    import optax
+
+    jnp = _jnp()
+    seq, b, a = data.shape
+    logits = jnp.transpose(data, (1, 0, 2))  # (B, T, A)
+    if use_data_lengths and data_lengths is not None:
+        t_steps = jnp.arange(seq)[None, :]
+        logitpad = (t_steps >= data_lengths[:, None]).astype(jnp.float32)
+    else:
+        logitpad = jnp.zeros((b, seq), jnp.float32)
+    labels = label.astype(_np.int32)
+    if use_label_lengths and label_lengths is not None:
+        l_steps = jnp.arange(labels.shape[1])[None, :]
+        labelpad = (l_steps >= label_lengths[:, None]).astype(jnp.float32)
+    else:
+        labelpad = (labels <= 0).astype(jnp.float32)  # 0 used as padding token
+    return optax.ctc_loss(logits, logitpad, labels, labelpad)

@@ -1,0 +1,206 @@
+"""Fused optimizer update operators.
+
+Reference: ``src/operator/optimizer_op.{cc,cu,-inl.h}`` (sgd_update,
+sgd_mom_update, adam_update, … — SURVEY.md §3.2 "Optimizer update ops").
+Each update is one pure jax function over (weight, grad, states…) returning
+the new (weight, states…); XLA fuses the whole update into a single kernel,
+which is what the reference's hand-fused CUDA kernels bought.  The Optimizer
+frontend jits these per (shape, dtype) so repeated steps hit the cache.
+"""
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _prep(grad, wd, weight, rescale_grad, clip_gradient):
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update", differentiable=False)
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", differentiable=False, nout=2)
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("nag_mom_update", differentiable=False, nout=2)
+def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("adam_update", differentiable=False, nout=3)
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True, t=1):
+    jnp = _jnp()
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    # bias correction folded into lr by the frontend (reference does the same)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w, new_mean, new_var
+
+
+@register("adagrad_update", differentiable=False, nout=2)
+def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_hist = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(new_hist) + epsilon), new_hist
+
+
+@register("adadelta_update", differentiable=False, nout=3)
+def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return weight - delta, new_acc_g, new_acc_delta
+
+
+@register("rmsprop_update", differentiable=False, nout=2)
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    jnp = _jnp()
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", differentiable=False, nout=3)
+def rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0):
+    # signature note: arrays are (weight, grad, n, g, delta)
+    jnp = _jnp()
+    gr = _prep(grad, wd, weight, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(gr)
+    new_g = gamma1 * g_state + (1 - gamma1) * gr
+    new_delta = gamma2 * delta - lr * gr / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    return weight + new_delta, new_n, new_g
+
+
+@register("ftrl_update", differentiable=False, nout=3)
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w, new_z, new_n
+
+
+@register("ftml_update", differentiable=False, nout=3)
+def ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1):
+    jnp = _jnp()
+    g = _prep(grad, wd, weight, rescale_grad, clip_grad if clip_grad > 0 else None)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v  # note: returns (weight, d, v); z handled by frontend
+
+
+@register("signsgd_update", differentiable=False)
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", differentiable=False, nout=2)
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.9, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom
+
+
+@register("lamb_update_phase1", differentiable=False)
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    jnp = _jnp()
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m_hat, v_hat = new_mean, new_var
+    if bias_correction:
+        m_hat = new_mean / (1 - beta1 ** t)
+        v_hat = new_var / (1 - beta2 ** t)
+    update = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * weight
+    return update
+
+
+@register("lamb_update_phase2", differentiable=False)
+def lamb_update_phase2(weight, g_update, r1, r2, lr=0.01, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    jnp = _jnp()
+    r1v = jnp.where(r1 > 0, r1, jnp.ones_like(r1))
+    r2v = jnp.where(r2 > 0, r2, jnp.ones_like(r2))
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1v / r2v, jnp.ones_like(r1))
+    if lower_bound is not None and lower_bound > 0:
+        ratio = jnp.maximum(ratio, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        ratio = jnp.minimum(ratio, upper_bound)
+    return weight - lr * ratio * g_update
+
+
+@register("multi_sgd_update", differentiable=False, nout="dynamic")
+def multi_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=1):
+    """Aggregated SGD over many params in one launch (reference:
+    multi_sgd_update / MXNET_OPTIMIZER_AGGREGATION_SIZE).  arrays =
+    [w0, g0, w1, g1, ...]."""
+    outs = []
+    for i in range(num_weights):
+        w, g = arrays[2 * i], arrays[2 * i + 1]
+        outs.append(sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                               rescale_grad=rescale_grad,
+                               clip_gradient=clip_gradient))
+    return tuple(outs) if len(outs) > 1 else outs[0]

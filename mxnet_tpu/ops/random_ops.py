@@ -1,0 +1,115 @@
+"""Random sampling operators.
+
+Reference: ``src/operator/random/*.{cc,cu}`` (sample_op, multisample_op,
+shuffle_op — SURVEY.md §3.2 "Random").  Every op takes an explicit jax PRNG
+key as its first array input (threaded by the frontend, see
+``mxnet_tpu/random.py``); the samplers are jax.random draws that XLA fuses.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jr():
+    from jax import random as jr
+
+    return jr
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _dt(dtype):
+    if dtype in (None, "None"):
+        return _np.float32
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    return _np.dtype(dtype)
+
+
+@register("random_uniform", creation=True, needs_rng=True, differentiable=False,
+          aliases=("uniform", "_sample_uniform"))
+def random_uniform(key, low=0.0, high=1.0, shape=None, dtype="float32"):
+    return _jr().uniform(key, tuple(shape), minval=low, maxval=high,
+                         dtype=_dt(dtype))
+
+
+@register("random_normal", creation=True, needs_rng=True, differentiable=False,
+          aliases=("normal", "_sample_normal"))
+def random_normal(key, loc=0.0, scale=1.0, shape=None, dtype="float32"):
+    return _jr().normal(key, tuple(shape), dtype=_dt(dtype)) * scale + loc
+
+
+@register("random_gamma", creation=True, needs_rng=True, differentiable=False,
+          aliases=("gamma_sample",))
+def random_gamma(key, alpha=1.0, beta=1.0, shape=None, dtype="float32"):
+    return _jr().gamma(key, alpha, tuple(shape), dtype=_dt(dtype)) * beta
+
+
+@register("random_exponential", creation=True, needs_rng=True, differentiable=False)
+def random_exponential(key, lam=1.0, shape=None, dtype="float32"):
+    return _jr().exponential(key, tuple(shape), dtype=_dt(dtype)) / lam
+
+
+@register("random_poisson", creation=True, needs_rng=True, differentiable=False)
+def random_poisson(key, lam=1.0, shape=None, dtype="float32"):
+    return _jr().poisson(key, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("random_negative_binomial", creation=True, needs_rng=True,
+          differentiable=False)
+def random_negative_binomial(key, k=1, p=1.0, shape=None, dtype="float32"):
+    jr = _jr()
+    # NB(k,p) = Poisson(Gamma(k, (1-p)/p))
+    g = jr.gamma(key, k, tuple(shape)) * (1 - p) / p
+    k2 = jr.fold_in(key, 1)
+    return jr.poisson(k2, g, tuple(shape)).astype(_dt(dtype))
+
+
+@register("random_randint", creation=True, needs_rng=True, differentiable=False)
+def random_randint(key, low=0, high=1, shape=None, dtype="int32"):
+    return _jr().randint(key, tuple(shape), int(low), int(high)).astype(_dt(dtype))
+
+
+@register("sample_multinomial", needs_rng=True, differentiable=False,
+          aliases=("multinomial",))
+def sample_multinomial(key, data, shape=1, get_prob=False, dtype="int32"):
+    jr = _jr()
+    jnp = _jnp()
+    n = shape if isinstance(shape, int) else int(_np.prod(shape))
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        draws = jr.categorical(key, logits, shape=(n,))
+        out = draws if isinstance(shape, int) and shape == 1 else draws.reshape(shape if not isinstance(shape, int) else (shape,))
+    else:
+        draws = jr.categorical(key, logits[:, None, :], axis=-1,
+                               shape=(data.shape[0], n))
+        out = draws.reshape((data.shape[0],) + ((shape,) if isinstance(shape, int) else tuple(shape)))
+        if isinstance(shape, int) and shape == 1:
+            out = out.reshape(data.shape[0])
+    return out.astype(_dt(dtype))
+
+
+@register("sample_uniform_like", needs_rng=True, differentiable=False,
+          aliases=("uniform_like",))
+def uniform_like(key, data, low=0.0, high=1.0):
+    return _jr().uniform(key, data.shape, minval=low, maxval=high,
+                         dtype=data.dtype)
+
+
+@register("sample_normal_like", needs_rng=True, differentiable=False,
+          aliases=("normal_like",))
+def normal_like(key, data, loc=0.0, scale=1.0):
+    return _jr().normal(key, data.shape, dtype=data.dtype) * scale + loc
+
+
+@register("bernoulli", creation=True, needs_rng=True, differentiable=False)
+def bernoulli(key, prob=0.5, shape=None, dtype="float32"):
+    return _jr().bernoulli(key, prob, tuple(shape)).astype(_dt(dtype))

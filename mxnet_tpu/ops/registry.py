@@ -1,0 +1,78 @@
+"""Single operator table driving the whole ``mx.nd.*`` surface.
+
+Reference: the NNVM op registry (``nnvm::Op`` + attr maps, SURVEY.md §3.1)
+plus the import-time Python codegen (``python/mxnet/ndarray/register.py``).
+The reference registers ~1000 C++ kernels with FInferShape/FCompute/FGradient
+attrs; here each op is ONE pure jax-traceable Python function — shape/type
+inference is jax abstract evaluation, FCompute is the function itself (XLA
+compiles it), FGradient is ``jax.vjp`` of it.  One table → generated python
+functions + docs, preserving the self-describing-surface property (§6.6).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "OP_TABLE"]
+
+OP_TABLE = {}
+
+
+class OpDef:
+    """One operator.
+
+    Attributes
+    ----------
+    fn : callable(*arrays, **attrs) -> array | tuple(arrays)
+        Pure, jax-traceable.  Array inputs positional, static attrs kwargs.
+    nout : int | 'dynamic'
+        Number of outputs (tuple length) — 'dynamic' inspects the result.
+    creation : bool
+        True for ops with no array inputs (zeros, arange, random samplers):
+        they accept ``ctx=``/``dtype=`` kwargs at the frontend.
+    needs_rng : bool
+        Frontend threads a jax PRNG key as the first positional array.
+    differentiable : bool
+        False -> never recorded on the autograd tape (int outputs etc.).
+    """
+
+    __slots__ = ("name", "fn", "nout", "creation", "needs_rng", "differentiable",
+                 "aliases")
+
+    def __init__(self, name, fn, nout=1, creation=False, needs_rng=False,
+                 differentiable=True, aliases=()):
+        self.name = name
+        self.fn = fn
+        self.nout = nout
+        self.creation = creation
+        self.needs_rng = needs_rng
+        self.differentiable = differentiable
+        self.aliases = aliases
+
+
+def register(name=None, nout=1, creation=False, needs_rng=False,
+             differentiable=True, aliases=()):
+    """Decorator: register a pure function as an operator."""
+
+    def _do(fn):
+        opname = name or fn.__name__
+        od = OpDef(opname, fn, nout=nout, creation=creation, needs_rng=needs_rng,
+                   differentiable=differentiable, aliases=aliases)
+        if opname in OP_TABLE:
+            raise MXNetError(f"duplicate op registration: {opname}")
+        OP_TABLE[opname] = od
+        for a in aliases:
+            OP_TABLE[a] = od
+        return fn
+
+    return _do
+
+
+def get_op(name):
+    od = OP_TABLE.get(name)
+    if od is None:
+        raise MXNetError(f"unknown operator {name!r}")
+    return od
+
+
+def list_ops():
+    return sorted(OP_TABLE)

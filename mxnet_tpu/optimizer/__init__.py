@@ -1,0 +1,7 @@
+from .optimizer import (Optimizer, SGD, NAG, Adam, AdaGrad, AdaDelta, RMSProp,
+                        Ftrl, FTML, Signum, SignSGD, LAMB, Nadam, Adamax, SGLD,
+                        Test, Updater, get_updater, create, register)
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta", "RMSProp",
+           "Ftrl", "FTML", "Signum", "SignSGD", "LAMB", "Nadam", "Adamax",
+           "SGLD", "Test", "Updater", "get_updater", "create", "register"]

@@ -1,0 +1,569 @@
+"""Optimizers.
+
+Reference: ``python/mxnet/optimizer/optimizer.py`` (~2.5k LoC: Optimizer
+registry + Updater, SGD/NAG/Adam/AdaGrad/AdaDelta/RMSProp/Ftrl/FTML/Signum/
+LAMB/…, lr/wd multipliers, aggregated updates — SURVEY.md §3.5) driving the
+fused update kernels in ``src/operator/optimizer_op.cc``.
+
+TPU-native: each update is a pure jax function (ops/optimizer_ops.py) that
+XLA fuses into one kernel per param.  State lives as NDArrays; ``Trainer``
+may instead stage the whole update into a sharded jit step (parallel/).
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as _np
+
+from ..base import Registry, MXNetError
+from ..ndarray import ndarray as _ndm
+from ..ndarray.ndarray import NDArray, invoke
+
+__all__ = ["Optimizer", "create", "register", "Updater", "get_updater"]
+
+_REG = Registry("optimizer")
+
+
+def register(cls):
+    _REG.register(cls)
+    return cls
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.create(name, **kwargs)
+
+
+class Optimizer:
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0, **extra):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    # -- lr / wd ----------------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been "
+                             "defined; cannot set learning rate")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name in self.param_dict:
+            lr *= self.param_dict[name].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif name is not None and name in self.lr_mult:
+            lr *= self.lr_mult[name]
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name in self.param_dict:
+            wd *= self.param_dict[name].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif name is not None and name in self.wd_mult:
+            wd *= self.wd_mult[name]
+        return wd
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("param_dict", None)
+        return d
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.param_dict = {}
+
+
+def _zeros_like(w):
+    return _ndm.invoke("zeros_like", [w], {})
+
+
+def _clip(v):
+    return -1.0 if v is None else float(v)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference: sgd_update/sgd_mom_update kernels)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient))
+        if state is None:
+            new_w = invoke("sgd_update", [weight, grad], kw)
+            weight._set(new_w._get())
+        else:
+            new_w, new_mom = invoke("sgd_mom_update", [weight, grad, state],
+                                    dict(momentum=self.momentum, **kw))
+            weight._set(new_w._get())
+            state._set(new_mom._get())
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight) if self.momentum != 0.0 else None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient))
+        if state is None:
+            weight._set(invoke("sgd_update", [weight, grad], kw)._get())
+        else:
+            new_w, new_mom = invoke("nag_mom_update", [weight, grad, state],
+                                    dict(momentum=self.momentum, **kw))
+            weight._set(new_w._get())
+            state._set(new_mom._get())
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        new_w, new_m, new_v = invoke(
+            "adam_update", [weight, grad, mean, var],
+            dict(lr=lr_t, beta1=self.beta1, beta2=self.beta2,
+                 epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+                 clip_gradient=_clip(self.clip_gradient)))
+        weight._set(new_w._get())
+        mean._set(new_m._get())
+        var._set(new_v._get())
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        new_w, new_h = invoke("adagrad_update", [weight, grad, state],
+                              dict(lr=lr, epsilon=self.float_stable_eps, wd=wd,
+                                   rescale_grad=self.rescale_grad,
+                                   clip_gradient=_clip(self.clip_gradient)))
+        weight._set(new_w._get())
+        state._set(new_h._get())
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_d = state
+        new_w, new_g, new_d = invoke(
+            "adadelta_update", [weight, grad, acc_g, acc_d],
+            dict(rho=self.rho, epsilon=self.epsilon, wd=wd,
+                 rescale_grad=self.rescale_grad,
+                 clip_gradient=_clip(self.clip_gradient)))
+        weight._set(new_w._get())
+        acc_g._set(new_g._get())
+        acc_d._set(new_d._get())
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like(weight), _zeros_like(weight), _zeros_like(weight))
+        return (_zeros_like(weight),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if not self.centered:
+            (n,) = state
+            new_w, new_n = invoke(
+                "rmsprop_update", [weight, grad, n],
+                dict(lr=lr, gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
+                     rescale_grad=self.rescale_grad,
+                     clip_gradient=_clip(self.clip_gradient),
+                     clip_weights=_clip(self.clip_weights)))
+            weight._set(new_w._get())
+            n._set(new_n._get())
+        else:
+            n, g, delta = state
+            new_w, new_n, new_g = invoke(
+                "rmspropalex_update", [weight, grad, n, g, delta],
+                dict(lr=lr, gamma1=self.gamma1, gamma2=self.gamma2,
+                     epsilon=self.epsilon, wd=wd,
+                     rescale_grad=self.rescale_grad,
+                     clip_gradient=_clip(self.clip_gradient)))
+            weight._set(new_w._get())
+            n._set(new_n._get())
+            g._set(new_g._get())
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        new_w, new_z, new_n = invoke(
+            "ftrl_update", [weight, grad, z, n],
+            dict(lr=lr, lamda1=self.lamda1, beta=self.beta, wd=wd,
+                 rescale_grad=self.rescale_grad,
+                 clip_gradient=_clip(self.clip_gradient)))
+        weight._set(new_w._get())
+        z._set(new_z._get())
+        n._set(new_n._get())
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        import jax.numpy as jnp
+
+        g = grad._get() * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._get()
+        new_v = self.beta2 * v._get() + (1 - self.beta2) * jnp.square(g)
+        d_t = (1 - self.beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d._get()
+        new_z = self.beta1 * z._get() + (1 - self.beta1) * g - sigma * weight._get()
+        weight._set(-new_z / d_t)
+        d._set(d_t)
+        v._set(new_v)
+        z._set(new_z)
+
+
+@register
+class SignSGD(Optimizer):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        new_w = invoke("signsgd_update", [weight, grad],
+                       dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                            clip_gradient=_clip(self.clip_gradient)))
+        weight._set(new_w._get())
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        new_w, new_m = invoke("signum_update", [weight, grad, state],
+                              dict(lr=lr, momentum=self.momentum, wd=wd,
+                                   rescale_grad=self.rescale_grad,
+                                   clip_gradient=_clip(self.clip_gradient),
+                                   wd_lh=self.wd_lh))
+        weight._set(new_w._get())
+        state._set(new_m._get())
+
+
+@register
+class LAMB(Optimizer):
+    """LAMB (reference 1.6: lamb_update_phase1/2 kernels)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        import jax.numpy as jnp
+
+        g = grad._get() * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        new_mean = self.beta1 * mean._get() + (1 - self.beta1) * g
+        new_var = self.beta2 * var._get() + (1 - self.beta2) * jnp.square(g)
+        m_hat = new_mean / (1 - self.beta1 ** t) if self.bias_correction else new_mean
+        v_hat = new_var / (1 - self.beta2 ** t) if self.bias_correction else new_var
+        update = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * weight._get()
+        r1 = jnp.sqrt(jnp.sum(jnp.square(weight._get())))
+        r2 = jnp.sqrt(jnp.sum(jnp.square(update)))
+        ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+        if self.lower_bound:
+            ratio = jnp.maximum(ratio, self.lower_bound)
+        if self.upper_bound:
+            ratio = jnp.minimum(ratio, self.upper_bound)
+        weight._set(weight._get() - lr * ratio * update)
+        mean._set(new_mean)
+        var._set(new_var)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        import jax.numpy as jnp
+
+        g = grad._get() * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._get()
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        mean, var = state
+        new_m = self.beta1 * mean._get() + (1 - self.beta1) * g
+        new_v = self.beta2 * var._get() + (1 - self.beta2) * jnp.square(g)
+        g_prime = g / (1 - self.m_schedule)
+        m_prime = new_m / (1 - m_schedule_next)
+        v_prime = new_v / (1 - self.beta2 ** t)
+        m_bar = (1 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._set(weight._get() - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon))
+        mean._set(new_m)
+        var._set(new_v)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr / (1 - self.beta1 ** t)
+        import jax.numpy as jnp
+
+        g = grad._get() * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._get()
+        mean, u = state
+        new_m = self.beta1 * mean._get() + (1 - self.beta1) * g
+        new_u = jnp.maximum(self.beta2 * u._get(), jnp.abs(g))
+        weight._set(weight._get() - lr_t * new_m / (new_u + 1e-8))
+        mean._set(new_m)
+        u._set(new_u)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        import jax.numpy as jnp
+        from .. import random as _rnd
+        from jax import random as jr
+
+        g = grad._get() * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._get()
+        noise = jr.normal(_rnd._next_key(), weight.shape).astype(weight._get().dtype)
+        weight._set(weight._get() - lr / 2 * g +
+                    jnp.sqrt(jnp.asarray(lr)) * noise)
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer used by tests (reference has the same)."""
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        weight._set((weight - self.lr * grad * self.rescale_grad)._get())
+
+
+class Updater:
+    """Applies an optimizer given (index, grad, weight) — the object that
+    runs server-side under ``update_on_kvstore`` (reference:
+    python/mxnet/optimizer/optimizer.py get_updater + kvstore set_optimizer)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        states = {k: (v.asnumpy() if hasattr(v, "asnumpy") else
+                      tuple(s.asnumpy() for s in v) if isinstance(v, tuple) else v)
+                  for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2 and isinstance(data[1], Optimizer):
+            states, self.optimizer = data
+        else:
+            states = data
+        from ..ndarray.ndarray import array as _array
+
+        out = {}
+        for k, v in states.items():
+            if isinstance(v, tuple):
+                out[k] = tuple(_array(s) for s in v)
+            elif isinstance(v, _np.ndarray):
+                out[k] = _array(v)
+            else:
+                out[k] = v
+        self.states = out
+        self.states_synced = {k: False for k in out}
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,85 @@
+"""Collective primitives over the device mesh.
+
+Reference mapping (SURVEY.md §6.8): these replace the reference's reducers —
+``CommCPU/CommDevice`` (src/kvstore/comm.h), tree allreduce (comm_tree.h),
+NCCL (kvstore_nccl.h) and the ps-lite push/pull — with XLA collectives that
+ride ICI/DCN.  Inside ``shard_map`` use the ``p*`` wrappers; at the array
+level use the host-sharding helpers.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+__all__ = ["psum", "pmean", "all_gather", "reduce_scatter", "ppermute",
+           "all_to_all", "allreduce_hosts", "barrier"]
+
+
+def psum(x, axis_name="dp"):
+    import jax
+
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name="dp"):
+    import jax
+
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name="dp", axis=0, tiled=True):
+    import jax
+
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name="dp", scatter_dimension=0):
+    import jax
+
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+def ppermute(x, perm, axis_name="sp"):
+    import jax
+
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name="sp", split_axis=0, concat_axis=0, tiled=True):
+    import jax
+
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=tiled)
+
+
+def allreduce_hosts(value):
+    """Allreduce a host-local array across all processes' devices: builds a
+    global array sharded over processes and psums it.  Used by the
+    dist_tpu_sync KVStore (single psum ≙ push+pull, SURVEY.md §4.4)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    if jax.process_count() == 1:
+        return value
+    mesh = Mesh(jax.devices(), ("w",))
+    # each process contributes its local value on its own device shard;
+    # stack over a leading axis, psum via sum-reduction of the global array
+    g = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("w")),
+        value[None].repeat(jax.local_device_count(), axis=0)
+        if hasattr(value, "repeat") else jnp.broadcast_to(value[None], (jax.local_device_count(),) + value.shape))
+
+    @partial(jax.jit, out_shardings=NamedSharding(mesh, P()))
+    def _sum(a):
+        return a.sum(axis=0) / jax.local_device_count()
+
+    return _sum(g)
+
+
+def barrier():
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("mxnet_tpu_barrier")

@@ -1,0 +1,53 @@
+"""Device-mesh construction for SPMD parallelism.
+
+The reference's parallelism (SURVEY.md §3.3) is KVStore data-parallelism plus
+manual device placement; the TPU build's idiomatic substrate is a named
+``jax.sharding.Mesh`` over which every flavor (dp/fsdp/tp/pp/sp/ep) is a
+PartitionSpec.  This module owns mesh creation and the session default mesh.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "get_default_mesh", "set_default_mesh", "AXES"]
+
+AXES = ("dp", "fsdp", "tp", "sp", "ep", "pp")
+
+_DEFAULT = None
+
+
+def make_mesh(dp=None, tp=1, sp=1, ep=1, pp=1, fsdp=1, devices=None):
+    """Build a Mesh with named axes; dp absorbs the remaining devices.
+
+    Example: 64 chips, tp=4 -> mesh ('dp','fsdp','tp','sp','ep','pp') =
+    (16,1,4,1,1,1).  Axes of size 1 are kept so PartitionSpecs are stable
+    across configurations.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    fixed = tp * sp * ep * pp * fsdp
+    if n % fixed != 0:
+        raise MXNetError(f"{n} devices not divisible by tp*sp*ep*pp*fsdp={fixed}")
+    if dp is None:
+        dp = n // fixed
+    if dp * fixed != n:
+        raise MXNetError(f"mesh {dp}x{fsdp}x{tp}x{sp}x{ep}x{pp} != {n} devices")
+    arr = _np.array(devices).reshape(dp, fsdp, tp, sp, ep, pp)
+    return Mesh(arr, AXES)
+
+
+def set_default_mesh(mesh):
+    global _DEFAULT
+    _DEFAULT = mesh
+
+
+def get_default_mesh():
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = make_mesh()
+    return _DEFAULT

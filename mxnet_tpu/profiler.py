@@ -1,0 +1,132 @@
+"""Profiler shim over jax.profiler.
+
+Reference: ``python/mxnet/profiler.py`` + ``src/profiler/`` (operator-level
+Chrome-trace profiler — SURVEY.md §6.1).  TPU mapping: set_config/start/stop
+drive ``jax.profiler`` traces viewable in TensorBoard/Perfetto (per-HLO-op
+attribution replaces per-engine-op events); user scopes map to
+``jax.profiler.TraceAnnotation`` / named scopes.
+"""
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+
+from .base import MXNetError
+
+__all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
+           "Task", "Frame", "Marker", "Counter", "Domain", "Scope"]
+
+_CONFIG = {"filename": "profile.json", "profile_all": False, "dir": None}
+_ACTIVE = False
+
+
+def set_config(profile_all=False, profile_symbolic=False,
+               profile_imperative=False, profile_memory=False,
+               profile_api=False, filename="profile.json",
+               continuous_dump=False, **kwargs):
+    _CONFIG.update(profile_all=profile_all, filename=filename)
+    _CONFIG["dir"] = os.path.dirname(os.path.abspath(filename)) or "."
+
+
+def start():
+    global _ACTIVE
+    import jax
+
+    logdir = _CONFIG.get("dir") or "."
+    jax.profiler.start_trace(os.path.join(logdir, "jax_trace"))
+    _ACTIVE = True
+
+
+def stop():
+    global _ACTIVE
+    import jax
+
+    if _ACTIVE:
+        jax.profiler.stop_trace()
+        _ACTIVE = False
+
+
+def pause():
+    stop()
+
+
+def resume():
+    start()
+
+
+def dump(finished=True, profile_process="worker"):
+    """The jax trace is written at stop(); this records the pointer file."""
+    with open(_CONFIG["filename"], "w") as f:
+        f.write('{"note": "trace written by jax.profiler; open the '
+                'jax_trace/ directory in TensorBoard or Perfetto"}\n')
+
+
+def dumps(reset=False):
+    return "<profile data in jax_trace/; open with TensorBoard>"
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+
+class _Scope:
+    def __init__(self, name):
+        self.name = name
+        self._ctx = None
+
+    def start(self):
+        import jax
+
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def stop(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+
+class Task(_Scope):
+    def __init__(self, domain=None, name="task"):
+        super().__init__(name)
+
+
+class Frame(_Scope):
+    def __init__(self, domain=None, name="frame"):
+        super().__init__(name)
+
+
+class Marker:
+    def __init__(self, domain=None, name="marker"):
+        self.name = name
+
+    def mark(self, scope="process"):
+        pass
+
+
+class Counter:
+    def __init__(self, domain=None, name="counter", value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+
+Scope = _Scope

@@ -1,0 +1,82 @@
+"""Global RNG state: ``mx.random.seed()`` and key distribution.
+
+Reference: ``python/mxnet/random.py`` + per-device RNG resources
+(src/resource.cc kRandom/kParallelRandom, SURVEY.md §3.1).  JAX RNG is
+explicit-key; the imperative frontend keeps a global key that every random op
+splits from — reproducing the reference's "global seed, stateful draw"
+semantics — while traced/hybridized code pulls keys from a trace-scoped base
+key (threaded in as a jit argument so each call gets fresh randomness).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed"]
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.key = None
+        self.trace_stack = []  # [(base_key, counter_box)] during jit tracing
+
+
+_S = _RngState()
+
+
+def _jr():
+    from jax import random as jr
+
+    return jr
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global RNG (reference: mx.random.seed)."""
+    _S.key = _jr().PRNGKey(int(seed_state))
+
+
+def _next_key():
+    """Next PRNG key: split the global key (eager) or fold a counter into the
+    trace-scoped base key (inside hybridize/jit tracing)."""
+    jr = _jr()
+    if _S.trace_stack:
+        base, box = _S.trace_stack[-1]
+        box[0] += 1
+        return jr.fold_in(base, box[0])
+    if _S.key is None:
+        _S.key = jr.PRNGKey(0)
+    _S.key, sub = jr.split(_S.key)
+    return sub
+
+
+def _push_trace_key(base_key):
+    box = [0]
+    _S.trace_stack.append((base_key, box))
+    return box
+
+
+def _pop_trace_key():
+    _S.trace_stack.pop()
+
+
+def uniform(low=0, high=1, shape=None, dtype="float32", ctx=None, out=None):
+    from .ndarray import ndarray as _nd
+
+    return _nd.invoke("random_uniform", [], {"low": low, "high": high,
+                                             "shape": shape or (1,), "dtype": dtype},
+                      out=out, ctx=ctx)
+
+
+def normal(loc=0, scale=1, shape=None, dtype="float32", ctx=None, out=None):
+    from .ndarray import ndarray as _nd
+
+    return _nd.invoke("random_normal", [], {"loc": loc, "scale": scale,
+                                            "shape": shape or (1,), "dtype": dtype},
+                      out=out, ctx=ctx)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    from .ndarray import ndarray as _nd
+
+    return _nd.invoke("random_randint", [], {"low": low, "high": high,
+                                             "shape": shape or (1,), "dtype": dtype},
+                      out=out, ctx=ctx)

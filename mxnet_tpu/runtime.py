@@ -1,0 +1,56 @@
+"""Runtime feature detection (reference: python/mxnet/runtime.py +
+src/libinfo.cc — build-flag capability query, SURVEY.md §6.6)."""
+from __future__ import annotations
+
+__all__ = ["Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__()
+        import jax
+
+        plats = {d.platform for d in jax.devices()}
+        feats = {
+            "TPU": bool(plats - {"cpu"}),
+            "CPU": True,
+            "CUDA": False,
+            "CUDNN": False,
+            "BF16": True,
+            "F16C": True,
+            "INT64_TENSOR_SIZE": True,
+            "JIT": True,
+            "PALLAS": _has_pallas(),
+            "DIST_KVSTORE": True,
+            "SIGNAL_HANDLER": True,
+            "MKLDNN": False,
+            "OPENCV": False,
+            "SPARSE": False,  # flips on when the sparse subsystem lands
+        }
+        for k, v in feats.items():
+            self[k] = Feature(k, v)
+
+    def is_enabled(self, name):
+        return self[name.upper()].enabled
+
+
+def _has_pallas():
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def feature_list():
+    return list(Features().values())

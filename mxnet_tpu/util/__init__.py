@@ -1,0 +1,25 @@
+"""Utilities (reference: python/mxnet/util.py)."""
+from . import test_utils  # noqa: F401
+
+
+def makedirs(d):
+    import os
+
+    os.makedirs(d, exist_ok=True)
+
+
+def get_gpu_count():
+    from ..context import num_gpus
+
+    return num_gpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    import jax
+
+    try:
+        d = jax.devices()[gpu_dev_id]
+        stats = d.memory_stats()
+        return stats.get("bytes_limit", 0), stats.get("bytes_in_use", 0)
+    except Exception:
+        return (0, 0)

@@ -1,0 +1,133 @@
+"""Test oracles.
+
+Reference: ``python/mxnet/test_utils.py`` (~3k LoC: assert_almost_equal with
+per-dtype tolerances, check_numeric_gradient via finite differences,
+check_symbolic_forward/backward, check_consistency across contexts,
+rand_ndarray, default_context — SURVEY.md §5 oracle list).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import cpu, current_context
+from ..ndarray.ndarray import NDArray, array
+
+__all__ = ["default_context", "assert_almost_equal", "almost_equal",
+           "rand_ndarray", "rand_shape_nd", "check_numeric_gradient",
+           "check_consistency", "same"]
+
+_DTYPE_RTOL = {_np.dtype(_np.float16): 1e-2, _np.dtype(_np.float32): 1e-4,
+               _np.dtype(_np.float64): 1e-6}
+_DTYPE_ATOL = {_np.dtype(_np.float16): 1e-2, _np.dtype(_np.float32): 1e-5,
+               _np.dtype(_np.float64): 1e-7}
+
+
+def default_context():
+    return current_context()
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def same(a, b):
+    return _np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    a, b = _as_np(a), _as_np(b)
+    rtol = rtol or _DTYPE_RTOL.get(a.dtype, 1e-4)
+    atol = atol or _DTYPE_ATOL.get(a.dtype, 1e-5)
+    return _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a_np, b_np = _as_np(a), _as_np(b)
+    rtol = rtol if rtol is not None else _DTYPE_RTOL.get(a_np.dtype, 1e-4)
+    atol = atol if atol is not None else _DTYPE_ATOL.get(a_np.dtype, 1e-5)
+    if not _np.allclose(a_np, b_np, rtol=rtol, atol=atol, equal_nan=True):
+        err = _np.abs(a_np - b_np)
+        rel = err / (_np.abs(b_np) + atol)
+        raise AssertionError(
+            f"{names[0]} != {names[1]} (rtol={rtol}, atol={atol}): max abs err "
+            f"{err.max():.3e}, max rel err {rel.max():.3e}\n"
+            f"{names[0]}: {a_np.ravel()[:8]}...\n{names[1]}: {b_np.ravel()[:8]}...")
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=ndim).tolist())
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=_np.float32,
+                 ctx=None):
+    if stype != "default":
+        raise NotImplementedError("sparse rand_ndarray arrives with the "
+                                  "sparse subsystem")
+    return array(_np.random.uniform(-1, 1, size=shape).astype(dtype), ctx=ctx)
+
+
+def check_numeric_gradient(f, inputs, grads=None, eps=1e-3, rtol=1e-2,
+                           atol=1e-3):
+    """Finite-difference check of f's gradients computed via autograd.
+
+    f: callable(*NDArrays) -> NDArray (scalar or any shape; summed for grad)
+    inputs: list of numpy arrays (float32/float64)
+    Reference: check_numeric_gradient (python/mxnet/test_utils.py).
+    """
+    from .. import autograd
+
+    nds = [array(x.astype(_np.float64).astype(_np.float32)) for x in inputs]
+    for nd in nds:
+        nd.attach_grad()
+    with autograd.record():
+        out = f(*nds)
+        loss = out.sum()
+    loss.backward()
+    analytic = [nd.grad.asnumpy() for nd in nds]
+
+    for i, x in enumerate(inputs):
+        numeric = _np.zeros_like(x, dtype=_np.float64)
+        flat = x.astype(_np.float64).ravel()
+        for j in range(flat.size):
+            xp = flat.copy()
+            xm = flat.copy()
+            xp[j] += eps
+            xm[j] -= eps
+            args_p = [a.copy() for a in inputs]
+            args_m = [a.copy() for a in inputs]
+            args_p[i] = xp.reshape(x.shape).astype(_np.float32)
+            args_m[i] = xm.reshape(x.shape).astype(_np.float32)
+            fp = float(f(*[array(a) for a in args_p]).sum().asscalar())
+            fm = float(f(*[array(a) for a in args_m]).sum().asscalar())
+            numeric.ravel()[j] = (fp - fm) / (2 * eps)
+        if not _np.allclose(analytic[i], numeric, rtol=rtol, atol=atol):
+            raise AssertionError(
+                f"numeric gradient check failed for input {i}:\n"
+                f"analytic: {analytic[i].ravel()[:6]}\n"
+                f"numeric:  {numeric.ravel()[:6]}")
+
+
+def check_consistency(f, inputs, ctx_list=None, dtypes=("float32",),
+                      rtol=None, atol=None):
+    """Run f on the same inputs across contexts/dtypes and compare
+    (reference: check_consistency cpu-vs-gpu oracle -> here cpu-vs-tpu /
+    fp32-vs-bf16 ladder)."""
+    ctx_list = ctx_list or [cpu()]
+    ref = None
+    for ctx in ctx_list:
+        for dt in dtypes:
+            nds = [array(x, ctx=ctx, dtype=dt) for x in inputs]
+            out = _as_np(f(*nds))
+            if ref is None:
+                ref = out
+            else:
+                rt = rtol or (1e-1 if dt == "bfloat16" else 1e-4)
+                at = atol or (1e-1 if dt == "bfloat16" else 1e-5)
+                if not _np.allclose(ref, out.astype(ref.dtype), rtol=rt, atol=at):
+                    raise AssertionError(
+                        f"inconsistent results on {ctx}/{dt}: "
+                        f"{ref.ravel()[:5]} vs {out.ravel()[:5]}")
+    return ref

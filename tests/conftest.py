@@ -1,0 +1,29 @@
+"""Test configuration: run the suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's "distributed without a cluster" strategy (SURVEY.md
+§5.4: launcher-local multi-process PS tests) using XLA's host-platform
+device-count flag, so KVStore/mesh/sharding tests exercise real collectives
+on 8 virtual devices with no TPU pod.
+"""
+import os
+
+# must run before jax initializes
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    """Seed discipline (reference: tests/python/unittest/common.py @with_seed):
+    every test runs with a fixed, reproducible seed."""
+    np.random.seed(0)
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    yield

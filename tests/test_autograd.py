@@ -1,0 +1,171 @@
+"""Autograd tape tests (reference model: tests/python/unittest/
+test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.util.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = nd.array([[1., 2.], [3., 4.]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(nd.log(x) * 2)  # x^2
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy(), rtol=1e-4)
+
+
+def test_multi_use_accumulates():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 3
+    y.backward()
+    assert_almost_equal(x.grad, np.array([2 * 2.0 + 3]))
+
+
+def test_head_grad():
+    x = nd.array([1., 2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10., 100.]))
+    assert_almost_equal(x.grad, np.array([20., 200.]))
+
+
+def test_grad_req_add():
+    x = nd.array([1., 1.])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad, np.array([6., 6.]))
+
+
+def test_grad_req_null():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="null")
+    with autograd.record():
+        y = x * 2
+    y.backward()
+    assert x.grad.asnumpy().sum() == 0
+
+
+def test_detach():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, np.array([9.0]))  # only d(z)/dx via second factor
+
+
+def test_stop_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) * x
+    y.backward()
+    assert_almost_equal(x.grad, np.array([9.0]))
+
+
+def test_pause():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = x * 100  # not recorded
+        w = y + z.detach()
+    w.backward()
+    assert_almost_equal(x.grad, np.array([2.0]))
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()  # 'write' req: second backward overwrites, not accumulates
+    assert_almost_equal(x.grad, g1)
+
+
+def test_double_backward_without_retain_raises():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    with pytest.raises(Exception):
+        y.backward()
+
+
+def test_mark_variables():
+    x = nd.array([1., 2.])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 5).sum()
+    y.backward()
+    assert_almost_equal(g, np.array([5., 5.]))
+
+
+def test_grad_function():
+    x = nd.array([1., 2., 3.])
+    with autograd.record():
+        x.attach_grad()
+        y = (x * x).sum()
+    grads = autograd.grad([y], [x])
+    assert_almost_equal(grads[0], 2 * x.asnumpy())
+
+
+def test_slice_grad():
+    x = nd.array(np.arange(6.).reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = x[0].sum()
+    y.backward()
+    expect = np.zeros((2, 3))
+    expect[0] = 1
+    assert_almost_equal(x.grad, expect)
+
+
+def test_softmax_output_grad():
+    """Loss-layer semantics: backward ignores out_grad (reference:
+    src/operator/softmax_output.cc)."""
+    data = nd.array([[1., 2., 3.]])
+    label = nd.array([2.])
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = np.exp([1, 2, 3]) / np.exp([1, 2, 3]).sum()
+    expect = p - np.array([0, 0, 1])
+    assert_almost_equal(data.grad, expect[None], rtol=1e-4)

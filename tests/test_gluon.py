@@ -1,0 +1,247 @@
+"""Gluon API tests (reference model: tests/python/unittest/test_gluon.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.util.test_utils import assert_almost_equal
+
+
+def test_dense_shapes():
+    layer = nn.Dense(8, in_units=4)
+    layer.initialize()
+    out = layer(nd.ones((2, 4)))
+    assert out.shape == (2, 8)
+
+
+def test_deferred_init():
+    layer = nn.Dense(8)
+    layer.initialize()
+    with pytest.raises(Exception):
+        layer.weight.data()
+    out = layer(nd.ones((2, 4)))
+    assert out.shape == (2, 8)
+    assert layer.weight.shape == (8, 4)
+
+
+def test_sequential_and_children():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation='relu'), nn.Dense(4))
+    net.initialize()
+    out = net(nd.ones((3, 8)))
+    assert out.shape == (3, 4)
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+    params = net.collect_params()
+    assert len(params) == 4  # 2 weights + 2 biases
+
+
+def test_param_save_load(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5, in_units=3), nn.Dense(2, in_units=5))
+    net.initialize()
+    x = nd.random.normal(shape=(1, 3))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "model.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(5, in_units=3), nn.Dense(2, in_units=5))
+    net2.load_parameters(f)
+    assert_almost_equal(net2(x), ref)
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation='relu'), nn.Dense(4))
+    net.initialize()
+    x = nd.random.normal(shape=(2, 8))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5)
+    hybrid2 = net(x).asnumpy()  # cached path
+    assert_almost_equal(eager, hybrid2, rtol=1e-5)
+
+
+def test_hybridize_grad_matches_eager():
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation='tanh', in_units=4), nn.Dense(2, in_units=8))
+        net.initialize(mx.init.Constant(0.05))
+        return net
+
+    x = nd.random.normal(shape=(3, 4))
+    grads = []
+    for hybrid in (False, True):
+        net = build()
+        if hybrid:
+            net.hybridize()
+            net(x)  # build cache
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        g = {k: p.grad().asnumpy() for k, p in net.collect_params().items()
+             if p.grad_req != 'null'}
+        grads.append(g)
+    for (k1, v1), (k2, v2) in zip(sorted(grads[0].items()), sorted(grads[1].items())):
+        assert_almost_equal(v1, v2, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_running_stats_update():
+    layer = nn.BatchNorm(in_channels=3)
+    layer.initialize()
+    x = nd.random.normal(loc=2.0, shape=(4, 3, 5, 5))
+    with autograd.record():
+        layer(x)
+    rm = layer.running_mean.data().asnumpy()
+    assert abs(rm).sum() > 0  # moved toward batch mean
+    # inference should use running stats, no update
+    before = layer.running_mean.data().asnumpy().copy()
+    layer(x)
+    assert_almost_equal(layer.running_mean.data(), before)
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation='relu'),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(16, 3, padding=1),
+            nn.BatchNorm(),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten(),
+            nn.Dense(10))
+    net.initialize()
+    out = net(nd.ones((2, 3, 16, 16)))
+    assert out.shape == (2, 10)
+
+
+def test_trainer_step_updates():
+    net = nn.Dense(1, in_units=2)
+    net.initialize(mx.init.Constant(1.0))
+    trainer = gluon.Trainer(net.collect_params(), 'sgd', {'learning_rate': 1.0})
+    x = nd.array([[1., 1.]])
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    # w -= lr * dL/dw ; dL/dw = x = 1 -> w: 1 -> 0
+    assert_almost_equal(net.weight.data(), np.zeros((1, 2)))
+
+
+def test_trainer_states_roundtrip(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), 'adam', {'learning_rate': 0.1})
+    x = nd.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    trainer.load_states(f)
+    assert trainer._optimizer is not None
+
+
+def test_constant_param():
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.const = self.params.get_constant('const', nd.array([1., 2.]))
+
+        def hybrid_forward(self, F, x, const):
+            return x * const
+
+    net = Net()
+    net.initialize()
+    out = net(nd.ones((2,)))
+    assert_almost_equal(out, np.array([1., 2.]))
+    assert net.const.grad_req == 'null'
+
+
+def test_losses():
+    pred = nd.array([[1., 2., 3.], [3., 2., 1.]])
+    label = nd.array([2., 0.])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    ref = -np.log(np.exp([3., 3.]) / np.exp([[1, 2, 3], [3, 2, 1]]).sum(1))
+    assert_almost_equal(l, ref, rtol=1e-4)
+
+    l1 = gluon.loss.L1Loss()(nd.array([[1., 2.]]), nd.array([[2., 4.]]))
+    assert_almost_equal(l1, np.array([1.5]))
+    l2 = gluon.loss.L2Loss()(nd.array([[1., 2.]]), nd.array([[2., 4.]]))
+    assert_almost_equal(l2, np.array([(1 + 4) / 2 / 2]))
+    hb = gluon.loss.HuberLoss()(nd.array([[0.5, 3.]]), nd.array([[0., 0.]]))
+    assert_almost_equal(hb, np.array([(0.5 * 0.25 + (3 - 0.5)) / 2]))
+    bce = gluon.loss.SigmoidBCELoss()(nd.array([[0.]]), nd.array([[1.]]))
+    assert_almost_equal(bce, np.array([np.log(2)]), rtol=1e-4)
+
+
+def test_rnn_layers():
+    for layer, nstate in [(gluon.rnn.LSTM(8, 2), 2), (gluon.rnn.GRU(8), 1),
+                          (gluon.rnn.RNN(8, activation='tanh'), 1)]:
+        layer.initialize()
+        x = nd.random.normal(shape=(5, 3, 4))  # TNC
+        out = layer(x)
+        assert out.shape == (5, 3, 8)
+        states = layer.begin_state(batch_size=3)
+        out, new_states = layer(x, states)
+        assert out.shape == (5, 3, 8)
+        assert len(new_states) == nstate
+
+
+def test_rnn_gradient_flows():
+    layer = gluon.rnn.LSTM(4)
+    layer.initialize()
+    x = nd.random.normal(shape=(3, 2, 5))
+    with autograd.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    params = layer.collect_params()
+    key = [k for k in params.keys() if k.endswith('l0_i2h_weight')][0]
+    g = params[key].grad()
+    assert abs(g.asnumpy()).sum() > 0
+
+
+def test_rnn_cells():
+    cell = gluon.rnn.LSTMCell(8)
+    cell.initialize()
+    x = nd.random.normal(shape=(2, 10, 4))  # NTC
+    outputs, states = cell.unroll(10, x, layout='NTC')
+    assert outputs.shape == (2, 10, 8)
+    assert len(states) == 2
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(20, 8)
+    emb.initialize()
+    out = emb(nd.array([[1, 2], [3, 4]]))
+    assert out.shape == (2, 2, 8)
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=2))
+    net.initialize()
+    repr(net)
+    net.summary(nd.ones((1, 2)))
+    assert "Dense" in capsys.readouterr().out
+
+
+def test_split_and_load():
+    data = nd.arange(0, 8).reshape(8, 1)
+    slices = gluon.utils.split_and_load(data, [mx.cpu(), mx.cpu()])
+    assert len(slices) == 2
+    assert slices[0].shape == (4, 1)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = sum((a.asnumpy() ** 2).sum() for a in arrays)
+    assert total <= 1.01

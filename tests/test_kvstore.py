@@ -1,0 +1,93 @@
+"""KVStore tests (reference model: tests/python/unittest/test_kvstore.py —
+single-process multi-"device" semantics)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, kvstore
+from mxnet_tpu.util.test_utils import assert_almost_equal
+
+
+def test_init_pull():
+    kv = kvstore.create('local')
+    kv.init('3', nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    kv.pull('3', out=out)
+    assert_almost_equal(out, np.ones((2, 3)))
+
+
+def test_push_aggregates():
+    kv = kvstore.create('device')
+    kv.init('k', nd.zeros((2, 2)))
+    vals = [nd.ones((2, 2)), nd.ones((2, 2)) * 2, nd.ones((2, 2)) * 3]
+    kv.push('k', vals)
+    out = nd.zeros((2, 2))
+    kv.pull('k', out=out)
+    assert_almost_equal(out, np.full((2, 2), 6.0))
+
+
+def test_multiple_keys():
+    kv = kvstore.create('local')
+    kv.init(['a', 'b'], [nd.zeros((2,)), nd.ones((3,))])
+    kv.push(['a', 'b'], [nd.ones((2,)), nd.ones((3,))])
+    oa, ob = nd.zeros((2,)), nd.zeros((3,))
+    kv.pull(['a', 'b'], out=[oa, ob])
+    assert_almost_equal(oa, np.ones(2))
+    assert_almost_equal(ob, np.ones(3))
+
+
+def test_pushpull():
+    kv = kvstore.create('local')
+    kv.init('x', nd.zeros((4,)))
+    v = nd.ones((4,))
+    kv.pushpull('x', v)
+    assert_almost_equal(v, np.ones(4))
+
+
+def test_update_on_kvstore():
+    """Server-side optimizer semantics (reference: §4.4 ApplyUpdates)."""
+    from mxnet_tpu import optimizer as opt
+
+    kv = kvstore.create('local')
+    kv.init(0, nd.ones((2, 2)))
+    kv.set_optimizer(opt.SGD(learning_rate=0.5))
+    kv.push(0, [nd.ones((2, 2))])  # grad = 1 -> w -= 0.5
+    out = nd.zeros((2, 2))
+    kv.pull(0, out=out)
+    assert_almost_equal(out, np.full((2, 2), 0.5))
+
+
+def test_row_sparse_pull():
+    kv = kvstore.create('local')
+    w = nd.array(np.arange(12.).reshape(4, 3))
+    kv.init('emb', w)
+    out = nd.zeros((2, 3))
+    kv.row_sparse_pull('emb', out=out, row_ids=nd.array([1, 3]))
+    assert_almost_equal(out, w.asnumpy()[[1, 3]])
+
+
+def test_gradient_compression():
+    kv = kvstore.create('local')
+    kv.init('g', nd.zeros((4,)))
+    kv.set_gradient_compression({'type': '2bit', 'threshold': 0.5})
+    kv.push('g', [nd.array([1.0, -1.0, 0.1, 0.0])])
+    out = nd.zeros((4,))
+    kv.pull('g', out=out)
+    # quantized to +-threshold or 0
+    assert set(np.unique(out.asnumpy())).issubset({-0.5, 0.0, 0.5})
+
+
+def test_dist_tpu_sync_single_process():
+    kv = kvstore.create('dist_tpu_sync')
+    assert kv.num_workers == 1
+    kv.init('w', nd.ones((2,)))
+    kv.push('w', [nd.ones((2,))])
+    out = nd.zeros((2,))
+    kv.pull('w', out=out)
+    assert_almost_equal(out, np.ones(2))
+
+
+def test_type_strings():
+    for t in ('local', 'device', 'nccl', 'dist_sync', 'dist_device_sync',
+              'dist_async', 'dist_tpu_sync'):
+        kv = kvstore.create(t)
+        assert kv.type == t

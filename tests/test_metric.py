@@ -1,0 +1,81 @@
+"""Metric tests (reference model: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, metric
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert acc == pytest.approx(2 / 3)
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.5, 0.4], [0.7, 0.2, 0.1]])
+    label = nd.array([2, 2])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_mae_mse_rmse():
+    pred = nd.array([[1.0], [2.0]])
+    label = nd.array([[1.5], [1.0]])
+    m = metric.MAE()
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.75)
+    m = metric.MSE()
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx((0.25 + 1.0) / 2)
+    m = metric.RMSE()
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(np.sqrt(0.625))
+
+
+def test_cross_entropy_perplexity():
+    pred = nd.array([[0.2, 0.8], [0.9, 0.1]])
+    label = nd.array([1, 0])
+    ce = metric.CrossEntropy()
+    ce.update([label], [pred])
+    ref = -(np.log(0.8) + np.log(0.9)) / 2
+    assert ce.get()[1] == pytest.approx(ref, rel=1e-5)
+    pp = metric.Perplexity()
+    pp.update([label], [pred])
+    assert pp.get()[1] == pytest.approx(np.exp(ref), rel=1e-5)
+
+
+def test_f1():
+    m = metric.F1()
+    pred = nd.array([[0.3, 0.7], [0.8, 0.2], [0.2, 0.8]])
+    label = nd.array([1, 0, 1])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_pearson():
+    m = metric.PearsonCorrelation()
+    pred = nd.array([1., 2., 3., 4.])
+    label = nd.array([2., 4., 6., 8.])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_composite_and_create():
+    m = metric.create(['accuracy', 'mae'])
+    pred = nd.array([[0.1, 0.9]])
+    label = nd.array([1])
+    m.update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names
+
+
+def test_custom():
+    m = metric.np(lambda label, pred: float((label == pred.argmax(1)).mean()))
+    m.update([nd.array([1])], [nd.array([[0.1, 0.9]])])
+    assert m.get()[1] == 1.0

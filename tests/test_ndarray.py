@@ -1,0 +1,180 @@
+"""NDArray semantics tests (reference model: tests/python/unittest/
+test_ndarray.py — creation, mutation, views, indexing, sync)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.util.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert a.size == 4
+    assert a.ndim == 2
+    z = nd.zeros((3, 4))
+    assert z.asnumpy().sum() == 0
+    o = nd.ones((2, 5), dtype='int32')
+    assert o.dtype == np.int32
+    assert o.asnumpy().sum() == 10
+    f = nd.full((2, 2), 7)
+    assert f.asnumpy().sum() == 28
+    r = nd.arange(0, 10, 2)
+    assert list(r.asnumpy()) == [0, 2, 4, 6, 8]
+
+
+def test_float64_downcast():
+    a = nd.array(np.random.randn(3, 3))  # float64 input
+    assert a.dtype == np.float32
+
+
+def test_arith():
+    a = nd.array([[1., 2.], [3., 4.]])
+    b = nd.array([[10., 20.], [30., 40.]])
+    assert_almost_equal(a + b, np.array([[11, 22], [33, 44]]))
+    assert_almost_equal(b - a, np.array([[9, 18], [27, 36]]))
+    assert_almost_equal(a * 2, np.array([[2, 4], [6, 8]]))
+    assert_almost_equal(2 * a, np.array([[2, 4], [6, 8]]))
+    assert_almost_equal(1 / a, 1 / a.asnumpy())
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(10 - a, 10 - a.asnumpy())
+    assert_almost_equal(a @ b, a.asnumpy() @ b.asnumpy())
+    assert_almost_equal(-a, -a.asnumpy())
+    assert_almost_equal(abs(-a), a.asnumpy())
+
+
+def test_broadcast():
+    a = nd.ones((2, 3))
+    b = nd.array([1., 2., 3.])
+    assert_almost_equal(a + b, np.ones((2, 3)) + np.array([1, 2, 3]))
+    assert (a + b).shape == (2, 3)
+
+
+def test_inplace_mutation():
+    a = nd.ones((2, 2))
+    a += 1
+    assert a.asnumpy().sum() == 8
+    a *= 2
+    assert a.asnumpy().sum() == 16
+    a[:] = 0
+    assert a.asnumpy().sum() == 0
+    a[0, 0] = 5
+    assert a.asnumpy()[0, 0] == 5
+
+
+def test_view_write_through():
+    """The single hardest semantic gap (SURVEY.md §8 hard part 1)."""
+    a = nd.array(np.arange(12.).reshape(3, 4))
+    v = a[1]
+    v[:] = -1
+    assert (a.asnumpy()[1] == -1).all()
+    r = a.reshape(4, 3)
+    r[0, 0] = 99
+    assert a.asnumpy()[0, 0] == 99
+    # view of view
+    vv = a[0:2][0]
+    vv[:] = 7
+    assert (a.asnumpy()[0] == 7).all()
+
+
+def test_indexing():
+    a = nd.array(np.arange(24.).reshape(2, 3, 4))
+    npa = np.arange(24.).reshape(2, 3, 4)
+    assert_almost_equal(a[1], npa[1])
+    assert_almost_equal(a[:, 1], npa[:, 1])
+    assert_almost_equal(a[0, 1, 2], npa[0, 1, 2])
+    assert_almost_equal(a[..., -1], npa[..., -1])
+    assert_almost_equal(a[:, ::2], npa[:, ::2])
+    idx = nd.array([0, 1], dtype='int32')
+    assert_almost_equal(a[idx], npa[[0, 1]])
+
+
+def test_reshape_specials():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape(-1).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((0, 0, 2, 2)).shape == (2, 3, 2, 2)
+    assert a.reshape(6, 4).shape == (6, 4)
+
+
+def test_copy_and_context():
+    a = nd.ones((2, 2))
+    b = a.copy()
+    b[:] = 5
+    assert a.asnumpy().sum() == 4  # copy is deep
+    c = nd.zeros((2, 2))
+    a.copyto(c)
+    assert c.asnumpy().sum() == 4
+    d = a.as_in_context(mx.cpu())
+    assert d.context.device_type == "cpu"
+
+
+def test_astype():
+    a = nd.ones((2, 2))
+    b = a.astype('int32')
+    assert b.dtype == np.int32
+    c = a.astype('bfloat16')
+    assert str(c.dtype) == 'bfloat16'
+
+
+def test_sync_and_wait():
+    a = nd.ones((8, 8))
+    b = (a * 2).sqrt()
+    b.wait_to_read()
+    nd.waitall()
+    assert b.asnumpy().shape == (8, 8)
+
+
+def test_scalar_conversions():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert int(a) == 3
+    assert a.asscalar() == np.float32(3.5)
+    assert bool(nd.array([1.0]))
+    with pytest.raises(Exception):
+        bool(nd.ones((2, 2)))
+
+
+def test_comparison_ops():
+    a = nd.array([1., 2., 3.])
+    b = nd.array([2., 2., 2.])
+    assert list((a == b).asnumpy()) == [0, 1, 0]
+    assert list((a > b).asnumpy()) == [0, 0, 1]
+    assert list((a <= b).asnumpy()) == [1, 1, 0]
+    assert list((a != b).asnumpy()) == [1, 0, 1]
+
+
+def test_iteration():
+    a = nd.array(np.arange(6.).reshape(3, 2))
+    rows = [r.asnumpy() for r in a]
+    assert len(rows) == 3
+    assert (rows[2] == [4, 5]).all()
+    assert len(a) == 3
+
+
+def test_concat_stack():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_out_kwarg():
+    a = nd.array([1., 4., 9.])
+    out = nd.zeros((3,))
+    nd.sqrt(a, out=out)
+    assert_almost_equal(out, np.array([1., 2., 3.]))
+
+
+def test_async_error_at_sync_point():
+    """Async error surfacing contract (reference: test_exc_handling.py —
+    invalid op raises at the sync point and the session survives)."""
+    a = nd.ones((4,))
+    with pytest.raises(Exception):
+        b = nd.Convolution(a, a, kernel=(3, 3), num_filter=1)  # bad rank
+        b.asnumpy()
+    # session still alive
+    assert nd.ones((2,)).asnumpy().sum() == 2

@@ -1,0 +1,271 @@
+"""Operator correctness vs numpy reference + numeric gradients (reference
+model: tests/python/unittest/test_operator.py — the single most important
+test file of the reference, SURVEY.md §5)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.util.test_utils import (assert_almost_equal,
+                                       check_numeric_gradient)
+
+
+def test_unary_vs_numpy():
+    x = np.random.uniform(0.5, 2.0, (3, 4)).astype('float32')
+    cases = {
+        "sqrt": np.sqrt, "exp": np.exp, "log": np.log, "abs": np.abs,
+        "square": np.square, "sign": np.sign, "sin": np.sin, "cos": np.cos,
+        "tanh": np.tanh, "floor": np.floor, "ceil": np.ceil,
+        "log1p": np.log1p, "expm1": np.expm1, "rsqrt": lambda a: 1 / np.sqrt(a),
+        "reciprocal": lambda a: 1 / a,
+    }
+    for name, ref in cases.items():
+        out = getattr(nd, name)(nd.array(x))
+        assert_almost_equal(out, ref(x), rtol=1e-4, atol=1e-5, names=(name, "np"))
+
+
+def test_binary_broadcast():
+    a = np.random.randn(2, 3, 1).astype('float32')
+    b = np.random.randn(1, 3, 4).astype('float32')
+    assert_almost_equal(nd.broadcast_add(nd.array(a), nd.array(b)), a + b)
+    assert_almost_equal(nd.broadcast_mul(nd.array(a), nd.array(b)), a * b)
+    assert_almost_equal(nd.broadcast_maximum(nd.array(a), nd.array(b)),
+                        np.maximum(a, b))
+    assert_almost_equal(nd.broadcast_power(nd.abs(nd.array(a)) + 1, nd.array(b)),
+                        np.power(np.abs(a) + 1, b), rtol=1e-3)
+
+
+def test_reductions():
+    x = np.random.randn(2, 3, 4).astype('float32')
+    a = nd.array(x)
+    assert_almost_equal(a.sum(), x.sum(), rtol=1e-4)
+    assert_almost_equal(a.sum(axis=1), x.sum(1), rtol=1e-4)
+    assert_almost_equal(a.mean(axis=(0, 2)), x.mean((0, 2)), rtol=1e-4)
+    assert_almost_equal(a.max(axis=-1, keepdims=True), x.max(-1, keepdims=True))
+    assert_almost_equal(a.min(), x.min())
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True), x.sum((0, 2)), rtol=1e-4)
+    assert_almost_equal(a.norm(), np.sqrt((x ** 2).sum()), rtol=1e-4)
+    assert_almost_equal(a.prod(axis=0), x.prod(0), rtol=1e-4)
+
+
+def test_argminmax_topk_sort():
+    x = np.random.randn(4, 5).astype('float32')
+    a = nd.array(x)
+    assert_almost_equal(a.argmax(axis=1), x.argmax(1).astype('float32'))
+    assert_almost_equal(a.argmin(axis=0), x.argmin(0).astype('float32'))
+    assert_almost_equal(a.sort(axis=1), np.sort(x, 1))
+    assert_almost_equal(a.sort(axis=1, is_ascend=False), -np.sort(-x, 1))
+    tk = a.topk(k=2, axis=1)  # indices of top-2 descending
+    ref = np.argsort(-x, axis=1)[:, :2].astype('float32')
+    assert_almost_equal(tk, ref)
+
+
+def test_dot_and_matmul():
+    a = np.random.randn(3, 4).astype('float32')
+    b = np.random.randn(4, 5).astype('float32')
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)), a @ b, rtol=1e-4)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b.T), transpose_b=True),
+                        a @ b, rtol=1e-4)
+    x = np.random.randn(2, 3, 4).astype('float32')
+    y = np.random.randn(2, 4, 5).astype('float32')
+    assert_almost_equal(nd.batch_dot(nd.array(x), nd.array(y)), x @ y, rtol=1e-4)
+
+
+def test_matrix_manip():
+    x = np.arange(24.).reshape(2, 3, 4).astype('float32')
+    a = nd.array(x)
+    assert_almost_equal(a.transpose(), x.T)
+    assert_almost_equal(a.transpose((1, 0, 2)), x.transpose(1, 0, 2))
+    assert_almost_equal(a.flatten(), x.reshape(2, -1))
+    assert_almost_equal(a.expand_dims(1), x[:, None])
+    assert_almost_equal(nd.squeeze(a.expand_dims(0)), x)
+    assert_almost_equal(a.swapaxes(0, 2), x.swapaxes(0, 2))
+    assert_almost_equal(a.tile((2, 1, 1)), np.tile(x, (2, 1, 1)))
+    assert_almost_equal(a.repeat(2, axis=1), x.repeat(2, 1))
+    assert_almost_equal(nd.reverse(a, axis=1), x[:, ::-1])
+    assert_almost_equal(nd.slice_axis(a, axis=2, begin=1, end=3), x[:, :, 1:3])
+    assert_almost_equal(nd.slice(a, begin=(0, 1), end=(2, 3)), x[0:2, 1:3])
+    assert_almost_equal(nd.broadcast_to(nd.ones((1, 3, 1)), shape=(2, 3, 4)),
+                        np.ones((2, 3, 4)))
+
+
+def test_split():
+    x = np.arange(12.).reshape(2, 6).astype('float32')
+    outs = nd.split(nd.array(x), num_outputs=3, axis=1)
+    assert len(outs) == 3
+    assert_almost_equal(outs[1], x[:, 2:4])
+    outs2 = nd.split(nd.array(x), num_outputs=2, axis=0, squeeze_axis=True)
+    assert outs2[0].shape == (6,)
+
+
+def test_indexing_ops():
+    w = np.random.randn(10, 4).astype('float32')
+    idx = np.array([1, 3, 5]).astype('float32')
+    assert_almost_equal(nd.take(nd.array(w), nd.array(idx)), w[[1, 3, 5]])
+    assert_almost_equal(nd.Embedding(nd.array(idx), nd.array(w)), w[[1, 3, 5]])
+    oh = nd.one_hot(nd.array([0, 2]), depth=4)
+    assert_almost_equal(oh, np.eye(4)[[0, 2]])
+    data = np.random.randn(3, 5).astype('float32')
+    pick_idx = np.array([0, 2, 4]).astype('float32')
+    assert_almost_equal(nd.pick(nd.array(data), nd.array(pick_idx), axis=1),
+                        data[np.arange(3), [0, 2, 4]])
+
+
+def test_where_clip():
+    x = np.random.randn(3, 4).astype('float32')
+    a = nd.array(x)
+    assert_almost_equal(a.clip(-0.5, 0.5), np.clip(x, -0.5, 0.5))
+    cond = nd.array((x > 0).astype('float32'))
+    assert_almost_equal(nd.where(cond, a, -a), np.where(x > 0, x, -x))
+
+
+def test_activations():
+    x = np.random.randn(3, 4).astype('float32')
+    a = nd.array(x)
+    assert_almost_equal(nd.relu(a), np.maximum(x, 0))
+    assert_almost_equal(nd.sigmoid(a), 1 / (1 + np.exp(-x)), rtol=1e-4)
+    assert_almost_equal(nd.LeakyReLU(a, act_type="leaky", slope=0.1),
+                        np.where(x > 0, x, 0.1 * x))
+    assert_almost_equal(nd.Activation(a, act_type="softrelu"),
+                        np.log1p(np.exp(x)), rtol=1e-4)
+    sm = nd.softmax(a, axis=1).asnumpy()
+    assert_almost_equal(sm.sum(1), np.ones(3), rtol=1e-5)
+    assert_almost_equal(nd.log_softmax(a, axis=1), np.log(sm), rtol=1e-4)
+
+
+def test_fully_connected():
+    x = np.random.randn(2, 5).astype('float32')
+    w = np.random.randn(3, 5).astype('float32')
+    b = np.random.randn(3).astype('float32')
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w), num_hidden=3, no_bias=True)
+    assert_almost_equal(out2, x @ w.T, rtol=1e-4)
+
+
+def test_convolution_shapes_and_value():
+    x = np.random.randn(2, 3, 8, 8).astype('float32')
+    w = np.random.randn(4, 3, 3, 3).astype('float32')
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3), num_filter=4,
+                         no_bias=True)
+    assert out.shape == (2, 4, 6, 6)
+    # value check against explicit correlation at one output position
+    ref = (x[0, :, 0:3, 0:3] * w[1]).sum()
+    assert_almost_equal(out.asnumpy()[0, 1, 0, 0], ref, rtol=1e-3)
+    # stride + pad + groups
+    out2 = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3), num_filter=4,
+                          stride=(2, 2), pad=(1, 1), no_bias=True)
+    assert out2.shape == (2, 4, 4, 4)
+    wg = np.random.randn(6, 1, 3, 3).astype('float32')
+    outg = nd.Convolution(nd.array(x), nd.array(wg), kernel=(3, 3), num_filter=6,
+                          num_group=3, pad=(1, 1), no_bias=True)
+    assert outg.shape == (2, 6, 8, 8)
+
+
+def test_pooling():
+    x = np.arange(16.).reshape(1, 1, 4, 4).astype('float32')
+    mp = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type='max')
+    assert_almost_equal(mp, np.array([[[[5, 7], [13, 15]]]]))
+    ap = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type='avg')
+    assert_almost_equal(ap, np.array([[[[2.5, 4.5], [10.5, 12.5]]]]))
+    gp = nd.Pooling(nd.array(x), global_pool=True, pool_type='avg')
+    assert_almost_equal(gp, np.array([[[[7.5]]]]))
+
+
+def test_batchnorm_inference():
+    x = np.random.randn(2, 3, 4, 4).astype('float32')
+    gamma, beta = np.ones(3, 'float32'), np.zeros(3, 'float32')
+    mean, var = np.zeros(3, 'float32'), np.ones(3, 'float32')
+    out, _, _ = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                             nd.array(mean), nd.array(var), fix_gamma=False,
+                             training=False)
+    assert_almost_equal(out, x, rtol=1e-3, atol=1e-3)
+
+
+def test_layernorm():
+    x = np.random.randn(2, 5).astype('float32')
+    g, b = np.ones(5, 'float32'), np.zeros(5, 'float32')
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_numeric_gradient_core_ops():
+    x = np.random.uniform(0.5, 1.5, (2, 3)).astype('float32')
+    check_numeric_gradient(lambda a: (a * a).sum(), [x])
+    check_numeric_gradient(lambda a: nd.tanh(a).sum(), [x])
+    check_numeric_gradient(lambda a: nd.softmax(a, axis=1).sum(), [x],
+                           rtol=5e-2, atol=1e-2)
+    w = np.random.uniform(-1, 1, (4, 3)).astype('float32')
+    check_numeric_gradient(
+        lambda a, ww: nd.FullyConnected(a, ww, num_hidden=4, no_bias=True).sum(),
+        [x, w], rtol=5e-2, atol=1e-2)
+
+
+def test_conv_gradient():
+    x = np.random.randn(1, 2, 5, 5).astype('float32')
+    w = np.random.randn(2, 2, 3, 3).astype('float32')
+    a, b = nd.array(x), nd.array(w)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = nd.Convolution(a, b, kernel=(3, 3), num_filter=2, no_bias=True)
+        loss = out.sum()
+    loss.backward()
+    assert a.grad.shape == x.shape
+    assert b.grad.shape == w.shape
+    assert abs(a.grad.asnumpy()).sum() > 0
+
+
+def test_linalg():
+    a = np.random.randn(3, 3).astype('float32')
+    spd = a @ a.T + 3 * np.eye(3, dtype='float32')
+    l = nd.linalg_potrf(nd.array(spd)).asnumpy()
+    assert_almost_equal(l @ l.T, spd, rtol=1e-3, atol=1e-3)
+    assert_almost_equal(nd.linalg_gemm2(nd.array(a), nd.array(a), transpose_b=True),
+                        a @ a.T, rtol=1e-4)
+    assert_almost_equal(nd.linalg_det(nd.array(spd)), np.linalg.det(spd),
+                        rtol=1e-3)
+
+
+def test_sequence_ops():
+    x = np.random.randn(4, 2, 3).astype('float32')  # (T, N, C)
+    lengths = np.array([2., 4.])
+    out = nd.sequence_mask(nd.array(x), nd.array(lengths),
+                           use_sequence_length=True, value=0.0)
+    assert (out.asnumpy()[2:, 0] == 0).all()
+    assert (out.asnumpy()[:, 1] == x[:, 1]).all()
+    last = nd.sequence_last(nd.array(x), nd.array(lengths),
+                            use_sequence_length=True)
+    assert_almost_equal(last.asnumpy()[0], x[1, 0])
+    assert_almost_equal(last.asnumpy()[1], x[3, 1])
+
+
+def test_cast_bf16():
+    x = nd.array([1.5, 2.5])
+    b = nd.cast(x, dtype='bfloat16')
+    assert str(b.dtype) == 'bfloat16'
+    back = nd.cast(b, dtype='float32')
+    assert_almost_equal(back, np.array([1.5, 2.5]))
+
+
+def test_ctc_loss():
+    T, B, A = 10, 2, 5
+    data = np.random.randn(T, B, A).astype('float32')
+    label = np.array([[1, 2], [2, 3]], dtype='float32')
+    loss = nd.CTCLoss(nd.softmax(nd.array(data), axis=-1).log(), nd.array(label))
+    assert loss.shape == (B,)
+    assert np.isfinite(loss.asnumpy()).all()
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=False):
+        out = nd.Dropout(x, p=0.5, training=False)
+    assert_almost_equal(out, np.ones((100, 100)))
+    with autograd.record():
+        out = nd.Dropout(x, p=0.5, training=True)
+    v = out.asnumpy()
+    assert 0.3 < (v == 0).mean() < 0.7  # roughly half dropped
+    kept = v[v != 0]
+    assert_almost_equal(kept, np.full_like(kept, 2.0))  # scaled by 1/keep

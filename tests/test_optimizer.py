@@ -1,0 +1,142 @@
+"""Optimizer tests: each update op vs a python/numpy reference (reference
+model: tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, optimizer as opt
+from mxnet_tpu.util.test_utils import assert_almost_equal
+
+
+def _setup(shape=(4, 3), seed=7):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(*shape).astype('float32')
+    g = rng.randn(*shape).astype('float32')
+    return w, g
+
+
+def _run(optimizer, w, g, steps=3):
+    weight = nd.array(w)
+    grad = nd.array(g)
+    state = optimizer.create_state(0, weight)
+    for _ in range(steps):
+        optimizer.update(0, weight, grad, state)
+    return weight.asnumpy()
+
+
+def test_sgd_vs_numpy():
+    w, g = _setup()
+    out = _run(opt.SGD(learning_rate=0.1, wd=0.01, rescale_grad=1.0), w, g)
+    ref = w.copy()
+    for _ in range(3):
+        ref = ref - 0.1 * (g + 0.01 * ref)
+    assert_almost_equal(out, ref, rtol=1e-5)
+
+
+def test_sgd_momentum_vs_numpy():
+    w, g = _setup()
+    out = _run(opt.SGD(learning_rate=0.1, momentum=0.9), w, g)
+    ref, mom = w.copy(), np.zeros_like(w)
+    for _ in range(3):
+        mom = 0.9 * mom - 0.1 * g
+        ref = ref + mom
+    assert_almost_equal(out, ref, rtol=1e-5)
+
+
+def test_adam_vs_numpy():
+    w, g = _setup()
+    out = _run(opt.Adam(learning_rate=0.01), w, g)
+    ref = w.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t in range(1, 4):
+        lr_t = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        ref = ref - lr_t * m / (np.sqrt(v) + eps)
+    assert_almost_equal(out, ref, rtol=1e-4)
+
+
+def test_adagrad_vs_numpy():
+    w, g = _setup()
+    out = _run(opt.AdaGrad(learning_rate=0.1), w, g)
+    ref, h = w.copy(), np.zeros_like(w)
+    for _ in range(3):
+        h += g * g
+        ref -= 0.1 * g / (np.sqrt(h) + 1e-7)
+    assert_almost_equal(out, ref, rtol=1e-4)
+
+
+def test_rmsprop_vs_numpy():
+    w, g = _setup()
+    out = _run(opt.RMSProp(learning_rate=0.01, gamma1=0.9), w, g)
+    ref, n = w.copy(), np.zeros_like(w)
+    for _ in range(3):
+        n = 0.9 * n + 0.1 * g * g
+        ref -= 0.01 * g / np.sqrt(n + 1e-8)
+    assert_almost_equal(out, ref, rtol=1e-4)
+
+
+def test_signum():
+    w, g = _setup()
+    out = _run(opt.Signum(learning_rate=0.1, momentum=0.9), w, g, steps=1)
+    # reference kernel: mom = b*mom - (1-b)*g ; w = (1-lr*wd_lh)*w + lr*sign(mom)
+    ref = w + 0.1 * np.sign(-0.1 * g)
+    assert_almost_equal(out, ref, rtol=1e-5)
+
+
+def test_lamb_runs():
+    w, g = _setup()
+    out = _run(opt.LAMB(learning_rate=0.01), w, g)
+    assert np.isfinite(out).all()
+    assert not np.allclose(out, w)
+
+
+@pytest.mark.parametrize("name", ["sgd", "nag", "adam", "adagrad", "adadelta",
+                                  "rmsprop", "ftrl", "ftml", "signum",
+                                  "signsgd", "lamb", "nadam", "adamax", "sgld",
+                                  "test"])
+def test_registry_create_and_step(name):
+    o = opt.create(name, learning_rate=0.01)
+    w, g = _setup((3,))
+    weight, grad = nd.array(w), nd.array(g)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    assert np.isfinite(weight.asnumpy()).all()
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import (FactorScheduler, MultiFactorScheduler,
+                                        PolyScheduler, CosineScheduler)
+
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(5) == 1.0
+    assert s(11) == 0.5
+    m = MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert m(1) == 1.0
+    assert abs(m(6) - 0.1) < 1e-9
+    p = PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert abs(p(50) - 0.5) < 1e-6
+    c = CosineScheduler(max_update=100, base_lr=1.0, warmup_steps=10)
+    assert c(5) < 1.0  # warmup
+    assert abs(c(100)) < 1e-6
+
+
+def test_lr_wd_mult():
+    o = opt.SGD(learning_rate=1.0)
+    o.set_lr_mult({0: 0.1})
+    o.set_wd_mult({0: 0.0})
+    assert o._get_lr(0) == pytest.approx(0.1)
+    assert o._get_wd(0) == 0.0
+
+
+def test_updater_states_roundtrip():
+    o = opt.Adam(learning_rate=0.1)
+    u = opt.get_updater(o)
+    w, g = _setup((3,))
+    u(0, nd.array(g), nd.array(w))
+    blob = u.get_states()
+    u2 = opt.get_updater(opt.Adam(learning_rate=0.1))
+    u2.set_states(blob)
+    assert 0 in u2.states

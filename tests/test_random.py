@@ -1,0 +1,71 @@
+"""Random op tests: seed reproducibility + distribution moments (reference
+model: tests/python/unittest/test_random.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_seed_reproducible():
+    mx.random.seed(123)
+    a = nd.random.normal(shape=(100,)).asnumpy()
+    mx.random.seed(123)
+    b = nd.random.normal(shape=(100,)).asnumpy()
+    assert (a == b).all()
+    c = nd.random.normal(shape=(100,)).asnumpy()
+    assert not (b == c).all()
+
+
+def test_uniform_moments():
+    mx.random.seed(0)
+    x = nd.random.uniform(2.0, 4.0, shape=(20000,)).asnumpy()
+    assert x.min() >= 2.0 and x.max() <= 4.0
+    assert abs(x.mean() - 3.0) < 0.05
+
+
+def test_normal_moments():
+    mx.random.seed(0)
+    x = nd.random.normal(1.0, 2.0, shape=(20000,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.1
+    assert abs(x.std() - 2.0) < 0.1
+
+
+def test_gamma_exponential_poisson():
+    mx.random.seed(0)
+    g = nd.random.gamma(2.0, 3.0, shape=(20000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.3
+    e = nd.random.exponential(2.0, shape=(20000,)).asnumpy()
+    assert abs(e.mean() - 2.0) < 0.1
+    p = nd.random.poisson(4.0, shape=(20000,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.2
+
+
+def test_randint():
+    x = nd.random.randint(0, 10, shape=(1000,)).asnumpy()
+    assert x.min() >= 0 and x.max() < 10
+    assert x.dtype == np.int32
+
+
+def test_multinomial():
+    mx.random.seed(0)
+    probs = nd.array([0.0, 0.0, 1.0])
+    draws = nd.random.multinomial(probs, shape=100).asnumpy()
+    assert (draws == 2).all()
+
+
+def test_shuffle():
+    x = nd.arange(0, 10)
+    y = nd.random.shuffle(x).asnumpy()
+    assert sorted(y.tolist()) == list(range(10))
+
+
+def test_dropout_rng_advances():
+    """Consecutive dropout calls must use different masks."""
+    from mxnet_tpu import autograd
+
+    mx.random.seed(0)
+    x = nd.ones((1000,))
+    with autograd.record():
+        a = nd.Dropout(x, p=0.5, training=True).asnumpy()
+        b = nd.Dropout(x, p=0.5, training=True).asnumpy()
+    assert not (a == b).all()

@@ -1,0 +1,84 @@
+"""NDArray save/load + RecordIO tests (reference model: serialization bits of
+test_ndarray.py + recordio tests)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.util.test_utils import assert_almost_equal
+
+
+def test_save_load_dict(tmp_path):
+    f = str(tmp_path / "arrays.params")
+    data = {"w": nd.array(np.random.randn(3, 4).astype('float32')),
+            "b": nd.arange(0, 5, dtype='int32')}
+    nd.save(f, data)
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], data["w"].asnumpy())
+    assert loaded["b"].dtype == np.int32
+    assert_almost_equal(loaded["b"], data["b"].asnumpy())
+
+
+def test_save_load_list(tmp_path):
+    f = str(tmp_path / "list.params")
+    nd.save(f, [nd.ones((2, 2)), nd.zeros((3,))])
+    loaded = nd.load(f)
+    assert isinstance(loaded, list)
+    assert loaded[0].shape == (2, 2)
+
+
+def test_recordio_roundtrip(tmp_path):
+    f = str(tmp_path / "data.rec")
+    w = recordio.MXRecordIO(f, "w")
+    for i in range(5):
+        w.write(f"record-{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(f, "r")
+    out = []
+    while True:
+        item = r.read()
+        if item is None:
+            break
+        out.append(item.decode())
+    assert out == [f"record-{i}" for i in range(5)]
+
+
+def test_indexed_recordio(tmp_path):
+    rec = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(5):
+        w.write_idx(i, f"item-{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(3).decode() == "item-3"
+    assert r.read_idx(0).decode() == "item-0"
+    assert len(r.keys) == 5
+
+
+def test_pack_unpack_img(tmp_path):
+    header = recordio.IRHeader(0, 7.0, 42, 0)
+    img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+    packed = recordio.pack_img(header, img, img_fmt=".npy")
+    hdr, img2 = recordio.unpack_img(packed)
+    assert hdr.label == 7.0
+    assert hdr.id == 42
+    assert (img2 == img).all()
+
+
+def test_image_record_dataset(tmp_path):
+    from mxnet_tpu.gluon.data.vision import ImageRecordDataset
+
+    rec = str(tmp_path / "imgs.rec")
+    idx = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(4):
+        img = np.full((4, 4, 3), i, dtype=np.uint8)
+        w.write_idx(i, recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                         img, img_fmt=".npy"))
+    w.close()
+    ds = ImageRecordDataset(rec)
+    assert len(ds) == 4
+    img, label = ds[2]
+    assert label == 2.0
+    assert (img == 2).all()
