@@ -1,0 +1,68 @@
+"""Headline benchmark: ResNet-50 fused training-step throughput (img/s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's recalled ResNet-50 fp32 per-accelerator training
+throughput on V100 (~350 img/s/GPU mid-range of BASELINE.md's 310–390) —
+the north-star target is per-chip parity within 10%.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S_PER_CHIP = 350.0
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch = 128 if on_tpu else 16
+    size = 224 if on_tpu else 64
+
+    net = vision.resnet50_v1()
+    net.initialize(ctx=mx.current_context())
+    net(mx.nd.zeros((1, 3, size, size)))  # settle deferred param shapes
+
+    def loss_fn(logits, labels):
+        import jax.numpy as jnp
+
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=-1)
+
+    step = TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                     train_mode=True)
+
+    x = np.random.uniform(-1, 1, (batch, 3, size, size)).astype("float32")
+    y = np.random.randint(0, 1000, (batch,)).astype("int32")
+
+    # warmup/compile
+    for _ in range(2):
+        step(x, y).block_until_ready()
+
+    iters = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    # scale CPU-smoke result is not comparable; report raw value regardless
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_s / BASELINE_IMG_S_PER_CHIP, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -158,6 +158,14 @@ class Parameter:
                 ".initialize() first")
 
     def data(self, ctx=None):
+        # under a jit/functionalize trace, hand back the traced stand-in so
+        # plain Blocks (not just HybridBlocks) read the traced value instead
+        # of baking the concrete buffer in as a constant
+        from .block import _TRACE
+
+        tc = _TRACE.ctx
+        if tc is not None and self in tc.param_map:
+            return tc.param_map[self]
         self._check_initialized()
         if ctx is None:
             return next(iter(self._data.values()))

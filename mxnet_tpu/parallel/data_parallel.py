@@ -1,0 +1,254 @@
+"""Sharded, jit-compiled training step over the device mesh.
+
+This is the TPU-native successor of the reference's whole DP stack
+(SURVEY.md §3.3: KVStoreLocal/CommDevice reduce + Trainer._allreduce_grads +
+optimizer update ops): one XLA program computes forward, backward, gradient
+reduction and the optimizer update, with collectives inserted by the
+compiler from sharding annotations (GSPMD) instead of hand-written NCCL/
+ps-lite calls (SURVEY.md §4.4 TPU mapping).
+
+- batch sharded over ``dp`` (and ``fsdp``) → grads of replicated params
+  become an automatic psum riding ICI;
+- ``param_sharding='fsdp'`` shards parameters/optimizer state over the
+  ``fsdp`` axis (ZeRO-style: all-gather on use, reduce-scatter on grads —
+  cf. PAPERS.md "Automatic Cross-Replica Sharding of Weight Update");
+- tensor-parallel specs from parallel.tensor_parallel compose with the same
+  step; everything under one jit.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from functools import partial
+
+from ..base import MXNetError
+from .functional import functionalize
+
+__all__ = ["TrainStep", "make_sgd_update", "make_adam_update",
+           "replicated_specs", "fsdp_specs"]
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# --------------------------------------------------------------------------
+# pure optimizer updates (the jit-fused analog of src/operator/optimizer_op.cc)
+# --------------------------------------------------------------------------
+def make_sgd_update(lr=0.01, momentum=0.9, wd=0.0):
+    import jax
+
+    def init(params):
+        return {"mom": jax.tree_util.tree_map(lambda p: p * 0.0, params)}
+
+    def update(params, grads, state):
+        def upd(p, g, m):
+            g = g + wd * p
+            m_new = momentum * m + g
+            return p - lr * m_new, m_new
+
+        out = jax.tree_util.tree_map(upd, params, grads, state["mom"])
+        new_p = jax.tree_util.tree_map(lambda t: t[0], out,
+                                       is_leaf=lambda t: isinstance(t, tuple))
+        new_m = jax.tree_util.tree_map(lambda t: t[1], out,
+                                       is_leaf=lambda t: isinstance(t, tuple))
+        return new_p, {"mom": new_m}
+
+    return init, update
+
+
+def make_adam_update(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, wd=0.0):
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        z = jax.tree_util.tree_map(lambda p: p * 0.0, params)
+        return {"m": z, "v": jax.tree_util.tree_map(lambda p: p * 0.0, params),
+                "t": jnp.zeros((), "int32")}
+
+    def update(params, grads, state):
+        t = state["t"] + 1
+        c1 = 1.0 - beta1 ** t.astype("float32")
+        c2 = 1.0 - beta2 ** t.astype("float32")
+
+        def upd(p, g, m, v):
+            g = g + wd * p
+            m_new = beta1 * m + (1 - beta1) * g
+            v_new = beta2 * v + (1 - beta2) * g * g
+            step = lr * (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+            return p - step.astype(p.dtype), m_new, v_new
+
+        out = jax.tree_util.tree_map(upd, params, grads, state["m"], state["v"])
+        pick = lambda i: jax.tree_util.tree_map(
+            lambda t_: t_[i], out, is_leaf=lambda t_: isinstance(t_, tuple))
+        return pick(0), {"m": pick(1), "v": pick(2), "t": t}
+
+    return init, update
+
+
+# --------------------------------------------------------------------------
+# sharding spec builders
+# --------------------------------------------------------------------------
+def replicated_specs(params):
+    from jax.sharding import PartitionSpec as P
+
+    return OrderedDict((k, P()) for k in params)
+
+
+def fsdp_specs(params, mesh, axis="fsdp"):
+    """Shard each parameter's largest divisible dim over the fsdp axis
+    (ZeRO-3 layout); fall back to replication for small/indivisible params."""
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    specs = OrderedDict()
+    for k, v in params.items():
+        spec = P()
+        if n > 1:
+            for d, size in enumerate(v.shape):
+                if size % n == 0 and size >= n:
+                    spec = P(*([None] * d + [axis]))
+                    break
+        specs[k] = spec
+    return specs
+
+
+class TrainStep:
+    """One fused XLA training step for a Gluon net.
+
+    Usage::
+
+        step = TrainStep(net, loss_fn, optimizer='sgd',
+                         optimizer_params={'learning_rate': 0.1},
+                         mesh=mesh, param_sharding='fsdp')
+        loss = step(x, y)          # x, y numpy/jax arrays (global batch)
+        step.write_back()          # sync trained params into the Gluon net
+    """
+
+    def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh=None, param_sharding="replicated", extra_param_specs=None,
+                 batch_axes=("dp", "fsdp"), donate=True, train_mode=True):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._net = net
+        apply_fn, params = functionalize(net, train_mode=train_mode,
+                                         with_state=train_mode)
+        self._apply_fn = apply_fn
+        self._with_state = train_mode
+        # split trainable vs frozen/state params (grad_req='null' covers
+        # BatchNorm running stats and user-frozen params): gradients and
+        # optimizer updates apply only to the trainable set
+        grad_req = {name: p.grad_req
+                    for name, p in net.collect_params().items()}
+        self._train_names = [k for k in params if grad_req.get(k) != "null"]
+        opt_params = dict(optimizer_params or {})
+        if optimizer == "sgd":
+            init, update = make_sgd_update(
+                lr=opt_params.get("learning_rate", 0.01),
+                momentum=opt_params.get("momentum", 0.0),
+                wd=opt_params.get("wd", 0.0))
+        elif optimizer == "adam":
+            init, update = make_adam_update(
+                lr=opt_params.get("learning_rate", 1e-3),
+                beta1=opt_params.get("beta1", 0.9),
+                beta2=opt_params.get("beta2", 0.999),
+                eps=opt_params.get("epsilon", 1e-8),
+                wd=opt_params.get("wd", 0.0))
+        else:
+            raise MXNetError(f"TrainStep optimizer {optimizer!r} not supported "
+                             "(use 'sgd' or 'adam', or the imperative Trainer)")
+
+        self._mesh = mesh
+        if mesh is not None:
+            if param_sharding == "fsdp":
+                specs = fsdp_specs(params, mesh)
+            elif param_sharding == "replicated":
+                specs = replicated_specs(params)
+            elif isinstance(param_sharding, dict):
+                specs = OrderedDict(
+                    (k, param_sharding.get(k, P())) for k in params)
+            else:
+                raise MXNetError(f"bad param_sharding {param_sharding!r}")
+            if extra_param_specs:
+                specs.update(extra_param_specs)
+            self._param_shard = OrderedDict(
+                (k, NamedSharding(mesh, s)) for k, s in specs.items())
+            self._batch_shard = NamedSharding(mesh, P(batch_axes))
+            params = OrderedDict(
+                (k, jax.device_put(v, self._param_shard[k]))
+                for k, v in params.items())
+        else:
+            self._param_shard = None
+            self._batch_shard = None
+            # copy: jit donation below must not invalidate the jax buffers
+            # the Gluon net's Parameters still reference
+            params = OrderedDict((k, jnp.array(v, copy=True))
+                                 for k, v in params.items())
+
+        train_names = self._train_names
+        self.train_params = OrderedDict((k, params[k]) for k in train_names)
+        self.rest_params = OrderedDict(
+            (k, v) for k, v in params.items() if k not in self.train_params)
+        self.opt_state = init(self.train_params)
+        if mesh is not None:
+            self.opt_state = jax.tree_util.tree_map(
+                lambda leaf: jax.device_put(leaf, NamedSharding(mesh, P()))
+                if leaf.ndim == 0 else leaf, self.opt_state)
+
+        with_state = self._with_state
+
+        def step(train_params, rest_params, opt_state, rng, x, y):
+            def loss_of(tp):
+                p = dict(rest_params)
+                p.update(tp)
+                if with_state:
+                    out, state = apply_fn(p, rng, x)
+                else:
+                    out = apply_fn(p, rng, x)
+                    state = {}
+                return jnp.mean(loss_fn(out, y)), state
+
+            (loss, state), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_params)
+            new_tp, new_opt = update(train_params, grads, opt_state)
+            new_rest = dict(rest_params)
+            for k, v in state.items():
+                if k in new_rest:
+                    new_rest[k] = v
+            return loss, new_tp, new_rest, new_opt
+
+        donate_argnums = (0, 1, 2) if donate else ()
+        self._step = jax.jit(step, donate_argnums=donate_argnums)
+        self._rng_seed = 0
+
+    @property
+    def params(self):
+        merged = OrderedDict(self.rest_params)
+        merged.update(self.train_params)
+        return merged
+
+    def __call__(self, x, y):
+        import jax
+        import numpy as _np
+        from jax import random as jr
+
+        x = getattr(x, "_get", lambda: x)()
+        y = getattr(y, "_get", lambda: y)()
+        if self._batch_shard is not None:
+            x = jax.device_put(_np.asarray(x), self._batch_shard)
+            y = jax.device_put(_np.asarray(y), self._batch_shard)
+        rng = jr.PRNGKey(self._rng_seed)
+        self._rng_seed += 1
+        loss, self.train_params, self.rest_params, self.opt_state = self._step(
+            self.train_params, self.rest_params, self.opt_state, rng, x, y)
+        return loss
+
+    def write_back(self):
+        """Copy trained parameter values back into the Gluon net."""
+        merged = self.params
+        for name, p in self._net.collect_params().items():
+            if name in merged:
+                p.data()._set(merged[name])

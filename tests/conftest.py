@@ -14,6 +14,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# the axon TPU-tunnel sitecustomize force-selects its platform via
+# jax.config; override back to CPU so the suite runs on the 8 virtual
+# devices (the env var alone is not enough once the plugin registered).
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,140 @@
+"""SPMD parallel layer: mesh construction, collectives, fused TrainStep.
+
+Reference analog: tests/python/unittest/test_kvstore.py + the nightly
+dist_sync_kvstore.py multi-process tests (SURVEY.md §5.4) — here exercised
+on the 8-virtual-device CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, autograd
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.data_parallel import TrainStep, fsdp_specs
+from mxnet_tpu.parallel.functional import functionalize
+
+
+def _tiny_net(classes=4):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Dense(classes))
+    net.initialize()
+    net(nd.zeros((2, 8)))
+    return net
+
+
+def _ce(logits, labels):
+    import jax
+    import jax.numpy as jnp
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1)
+
+
+def test_functionalize_matches_eager():
+    net = _tiny_net()
+    import jax
+
+    apply_fn, params = functionalize(net)
+    x = np.random.randn(4, 8).astype("float32")
+    out = apply_fn(params, jax.random.PRNGKey(0), x)
+    eager = net(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(np.asarray(out), eager, rtol=1e-5, atol=1e-5)
+
+
+def test_functionalize_plain_block_params_traced():
+    """Plain (non-hybrid) Blocks must read traced param values, not bake
+    constants (otherwise grads silently vanish)."""
+    import jax
+
+    class Plain(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.w = self.params.get("w", shape=(3, 3), init="ones")
+
+        def forward(self, x):
+            return nd.dot(x, self.w.data())
+
+    net = Plain()
+    net.initialize()
+    apply_fn, params = functionalize(net)
+    (name,) = list(params)
+
+    def loss(p, x):
+        return apply_fn(p, jax.random.PRNGKey(0), x).sum()
+
+    x = np.random.randn(2, 3).astype("float32")
+    grads = jax.grad(loss)(params, x)
+    assert float(np.abs(np.asarray(grads[name])).sum()) > 0
+
+
+def test_train_step_single_device_loss_decreases():
+    net = _tiny_net()
+    step = TrainStep(net, _ce, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    x = np.random.randn(32, 8).astype("float32")
+    y = (x.sum(axis=1) > 0).astype("int32")
+    first = float(step(x, y))
+    for _ in range(20):
+        last = float(step(x, y))
+    assert last < first
+
+    # BatchNorm moving stats must have moved (state threading works)
+    bn_means = [v for k, v in step.params.items() if "running_mean" in k]
+    assert bn_means and float(np.abs(np.asarray(bn_means[0])).sum()) > 0
+
+    # write_back must not crash and must sync values
+    step.write_back()
+    for name, p in net.collect_params().items():
+        np.testing.assert_allclose(p.data().asnumpy(),
+                                   np.asarray(step.params[name]), rtol=1e-6)
+
+
+def test_train_step_net_stays_alive_after_donation():
+    """Donated jit args must not invalidate the Gluon net's own buffers."""
+    net = _tiny_net()
+    step = TrainStep(net, _ce, optimizer="sgd")
+    x = np.random.randn(8, 8).astype("float32")
+    y = np.zeros((8,), "int32")
+    step(x, y)
+    out = net(nd.array(x))  # would raise "Array has been deleted" if aliased
+    assert out.shape == (8, 4)
+
+
+def test_train_step_fsdp_mesh_matches_single_device():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh(dp=2, fsdp=2, tp=2, devices=jax.devices()[:8])
+    net = _tiny_net()
+    stepm = TrainStep(net, _ce, optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1},
+                      mesh=mesh, param_sharding="fsdp",
+                      batch_axes=("dp", "fsdp"))
+    net2 = _tiny_net()
+    # same initial params
+    for (k, v), (k2, p2) in zip(sorted(stepm.params.items()),
+                                sorted(net2.collect_params().items())):
+        p2.data()._set(v)
+    steps = TrainStep(net2, _ce, optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+    x = np.random.randn(8, 8).astype("float32")
+    y = (x.sum(axis=1) > 0).astype("int32")
+    for _ in range(3):
+        lm = float(stepm(x, y))
+        ls = float(steps(x, y))
+    np.testing.assert_allclose(lm, ls, rtol=1e-4, atol=1e-5)
+
+
+def test_adam_train_step():
+    net = _tiny_net()
+    step = TrainStep(net, _ce, optimizer="adam",
+                     optimizer_params={"learning_rate": 0.01})
+    x = np.random.randn(16, 8).astype("float32")
+    y = (x.sum(axis=1) > 0).astype("int32")
+    first = float(step(x, y))
+    for _ in range(20):
+        last = float(step(x, y))
+    assert last < first
