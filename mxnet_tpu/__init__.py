@@ -45,6 +45,12 @@ from . import lr_scheduler
 from . import metric
 from . import kvstore
 from . import kvstore as kv
+from . import symbol
+from . import symbol as sym
+from .executor import Executor
+from . import module
+from . import module as mod
+from . import model
 from . import gluon
 from . import io
 from . import recordio

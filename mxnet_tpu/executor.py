@@ -1,0 +1,315 @@
+"""Executor: a bound, compiled symbolic graph (reference:
+``python/mxnet/executor.py`` + ``src/executor/graph_executor.cc``).
+
+The reference's GraphExecutor runs nnvm passes at bind time (shape/type
+inference, memory planning, op-exec attachment) then pushes topo-ordered
+segments onto the dependency engine.  Here bind compiles the WHOLE graph —
+forward, and forward+backward for training — into single ``jax.jit``
+computations: XLA does the memory planning (≙ PlanMemory), fusion
+(≙ pointwise_fusion_pass) and scheduling (≙ engine), and the MXU gets one
+large program instead of per-op kernel launches.  Gradient construction is
+``jax.vjp`` over the interpreted graph (≙ nnvm "Gradient" pass applying
+per-op FGradient).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["Executor"]
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+class _LazyOutputs:
+    """Sequence proxy returned by forward(is_train=True): touching it
+    materializes outputs via the forward jit; leaving it untouched lets the
+    fused fwd+bwd jit (backward) produce them for free."""
+
+    __slots__ = ("_ex",)
+
+    def __init__(self, ex):
+        self._ex = ex
+
+    def __getitem__(self, i):
+        return self._ex.outputs[i]
+
+    def __len__(self):
+        return len(self._ex.outputs)
+
+    def __iter__(self):
+        return iter(self._ex.outputs)
+
+
+class Executor:
+    """A symbol bound to argument/aux/grad buffers, compiled on demand."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        from .context import current_context
+        from .ndarray import NDArray
+
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        # normalize args to an ordered dict name -> NDArray
+        if isinstance(args, (list, tuple)):
+            if len(args) != len(arg_names):
+                raise MXNetError(
+                    f"bind: {len(arg_names)} arguments expected, got {len(args)}")
+            args = dict(zip(arg_names, args))
+        elif args is None:
+            args = {}
+        missing = [n for n in arg_names if n not in args]
+        if missing:
+            raise MXNetError(f"bind: missing arguments {missing}")
+        self.arg_dict = OrderedDict((n, args[n]) for n in arg_names)
+
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        elif aux_states is None:
+            aux_states = {}
+        missing = [n for n in aux_names if n not in aux_states]
+        if missing:
+            raise MXNetError(f"bind: missing auxiliary states {missing}")
+        self.aux_dict = OrderedDict((n, aux_states[n]) for n in aux_names)
+
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        self.grad_dict = OrderedDict(
+            (n, (args_grad or {}).get(n)) for n in arg_names)
+
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self.grad_req = {n: grad_req.get(n, "null") for n in arg_names}
+
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self._outputs = []
+        self._vjp_inputs = None     # values captured by the last train forward
+        self._fwd_cache = {}        # (shapes, dtypes, training) -> jitted fn
+        self._bwd_cache = {}
+        self._NDArray = NDArray
+
+    @property
+    def outputs(self):
+        """Lazy for training forwards: the fused fwd+bwd jit computes them,
+        so a plain forward→backward step runs the forward exactly once."""
+        if self._outputs is None:
+            self._materialize_outputs()
+        return self._outputs
+
+    # -- compiled graph functions ------------------------------------------
+    def _make_forward(self, training):
+        from .symbol.symbol import evaluate
+
+        heads = self._symbol._heads
+
+        def fn(arg_vals, aux_vals, rng):
+            feed = dict(arg_vals)
+            feed.update(aux_vals)
+            outs, state = evaluate(heads, feed, rng_key=rng,
+                                   training=training, collect_state=training)
+            return outs, state
+
+        return _jax().jit(fn, static_argnums=())
+
+    def _make_fused(self, seed_ones):
+        """One jitted computation: forward, state collection, AND gradients —
+        the whole training step's compute in a single XLA program (the
+        reference gets the same effect from engine bulking of the fwd+bwd
+        segments; here XLA also fuses across the boundary)."""
+        import jax.numpy as jnp
+
+        from .symbol.symbol import evaluate
+
+        heads = self._symbol._heads
+        grad_names = [n for n in self._arg_names
+                      if self.grad_req.get(n, "null") != "null"]
+
+        def fused(grad_args, other_args, aux_vals, rng, out_grads):
+            def f(ga):
+                feed = dict(other_args)
+                feed.update(ga)
+                feed.update(aux_vals)
+                outs, state = evaluate(heads, feed, rng_key=rng,
+                                       training=True, collect_state=True)
+                return outs, state
+
+            outs, vjp_fn, state = _jax().vjp(f, grad_args, has_aux=True)
+            if seed_ones:
+                ogs = [jnp.ones(o.shape, o.dtype) for o in outs]
+            else:
+                ogs = out_grads
+            (grads,) = vjp_fn(ogs)
+            return outs, state, grads
+
+        return _jax().jit(fused), grad_names
+
+    def _sig(self, training):
+        shapes = tuple((n, a.shape, str(a.dtype))
+                       for n, a in self.arg_dict.items())
+        return (shapes, training)
+
+    # -- public API --------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        from . import random as _rnd
+        from .ndarray import NDArray
+
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"unknown argument {k!r}")
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._set(v._get().astype(
+                    self.arg_dict[k]._get().dtype))
+            else:
+                import jax.numpy as jnp
+
+                self.arg_dict[k]._set(
+                    jnp.asarray(v, dtype=self.arg_dict[k]._get().dtype))
+
+        arg_vals = {n: a._get() for n, a in self.arg_dict.items()}
+        aux_vals = {n: a._get() for n, a in self.aux_dict.items()}
+        rng = _rnd._next_key()
+        if is_train:
+            # lazy: the fused fwd+bwd jit (backward()) computes outputs too,
+            # so the common forward→backward step runs ONE forward; outputs
+            # materialize on demand if read before backward
+            self._vjp_inputs = (arg_vals, aux_vals, rng)
+            self._outputs = None
+            return _LazyOutputs(self)
+        key = self._sig(False)
+        jitted = self._fwd_cache.get(key)
+        if jitted is None:
+            jitted = self._make_forward(False)
+            self._fwd_cache[key] = jitted
+        outs, _ = jitted(arg_vals, aux_vals, rng)
+        self._vjp_inputs = None
+        self._outputs = [NDArray._from_jax(v, self._ctx) for v in outs]
+        return self._outputs
+
+    def _materialize_outputs(self):
+        from .ndarray import NDArray
+
+        if self._vjp_inputs is None:
+            self._outputs = []
+            return
+        arg_vals, aux_vals, rng = self._vjp_inputs
+        key = self._sig(True)
+        jitted = self._fwd_cache.get(key)
+        if jitted is None:
+            jitted = self._make_forward(True)
+            self._fwd_cache[key] = jitted
+        outs, state = jitted(arg_vals, aux_vals, rng)
+        for name, val in state.items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._set(val)
+        self._outputs = [NDArray._from_jax(v, self._ctx) for v in outs]
+
+    def backward(self, out_grads=None):
+        if self._vjp_inputs is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        import jax.numpy as jnp
+
+        from .ndarray import NDArray
+
+        seed_ones = out_grads is None
+        key = (self._sig(True), seed_ones)
+        entry = self._bwd_cache.get(key)
+        if entry is None:
+            entry = self._make_fused(seed_ones)
+            self._bwd_cache[key] = entry
+        fused, grad_names = entry
+        if not grad_names:
+            return
+
+        arg_vals, aux_vals, rng = self._vjp_inputs
+        grad_args = {n: arg_vals[n] for n in grad_names}
+        other_args = {n: v for n, v in arg_vals.items() if n not in grad_args}
+
+        if seed_ones:
+            ogs = []
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ogs = [g._get() if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+        outs, state, grads = fused(grad_args, other_args, aux_vals, rng, ogs)
+        if self._outputs is None:
+            self._outputs = [NDArray._from_jax(v, self._ctx) for v in outs]
+            for name, val in state.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set(val)
+        for n, g in grads.items():
+            req = self.grad_req.get(n, "null")
+            if req == "null":
+                continue
+            buf = self.grad_dict.get(n)
+            if buf is None:
+                from .ndarray import zeros
+
+                buf = zeros(g.shape, ctx=self._ctx)
+                self.grad_dict[n] = buf
+            if req == "add":
+                buf._set(buf._get() + g)
+            else:
+                buf._set(g.astype(buf._get().dtype))
+
+    # -- conveniences (reference executor surface) -------------------------
+    @property
+    def arg_arrays(self):
+        return list(self.arg_dict.values())
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict[n] for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return list(self.aux_dict.values())
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in (arg_params or {}).items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set(
+                    arr._get().astype(self.arg_dict[name]._get().dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown parameter {name!r}")
+        for name, arr in (aux_params or {}).items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._set(
+                    arr._get().astype(self.aux_dict[name]._get().dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state {name!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        from .ndarray import zeros
+
+        shapes = {n: a.shape for n, a in self.arg_dict.items()}
+        shapes.update(kwargs)
+        args = {n: zeros(s, ctx=self._ctx) for n, s in shapes.items()}
+        for n, a in self.arg_dict.items():
+            if args[n].shape == a.shape:
+                args[n]._set(a._get())
+        grads = None
+        if any(r != "null" for r in self.grad_req.values()):
+            grads = {n: zeros(s, ctx=self._ctx) for n, s in shapes.items()}
+        return Executor(self._symbol, self._ctx, args=args, args_grad=grads,
+                        grad_req=self.grad_req, aux_states=dict(self.aux_dict))
+
+    @property
+    def output_dict(self):
+        return OrderedDict(zip(self._symbol.list_outputs(), self.outputs))

@@ -335,6 +335,12 @@ class HybridBlock(Block):
         Here the cached op is a jax.jit'd pure function."""
         import jax
 
+        # remembered for export() so the symbol trace can re-run shape-true
+        # (avals only — holding the arrays would pin the last batch on device)
+        self._last_input_shapes = tuple(
+            jax.ShapeDtypeStruct(tuple(a.shape), _np.dtype(a.dtype))
+            for a in args if isinstance(a, NDArray))
+
         # deferred param shapes unresolved -> run the eager path once (it
         # settles them, recording normally); the next call builds the cache
         all_params = [p for _, p in sorted(self.collect_params().items())]
@@ -454,20 +460,150 @@ class HybridBlock(Block):
         self._cached_graph[key] = entry
         return entry
 
-    def export(self, path, epoch=0):
-        """Reference: HybridBlock.export -> symbol.json + .params.  Here:
-        save params in .params format; graph export lands with the Symbol
-        layer."""
-        params = self._collect_params_with_prefix()
+    def _trace_to_symbol(self, *args):
+        """Trace ``forward`` with SymbolTracer proxies → (Symbol, arg_params,
+        aux_params).  Reference: _get_graph building the Symbol from
+        hybrid_forward (SURVEY.md §4.6); here imperative forward code runs
+        unmodified against graph-building proxies."""
+        import jax
+
+        from ..ndarray import ndarray as _ndmod
+        from ..symbol.symbol import SymbolTracer, _Node, Symbol
+
+        plist = sorted(self._collect_params_with_prefix().items())
+        param_map = {}
+        tracers = {}
+        for name, p in plist:
+            d = p.data()
+            aval = jax.ShapeDtypeStruct(d.shape, _np.dtype(d.dtype))
+            node = _Node(None, name, {})
+            param_map[p] = SymbolTracer((node, 0), aval)
+            tracers[name] = param_map[p]
+
+        in_tracers = []
+        for i, a in enumerate(args):
+            name = "data" if len(args) == 1 else f"data{i}"
+            aval = jax.ShapeDtypeStruct(tuple(a.shape), _np.dtype(a.dtype))
+            in_tracers.append(SymbolTracer((_Node(None, name, {}), 0), aval))
+
+        tc = _TraceContext(param_map)
+        prev = _TRACE.ctx
+        _TRACE.ctx = tc
+        prev_train = _ag.set_training(False)
+        prev_rec = _ag.set_recording(False)
+        _ndmod._SYMTRACE["on"] = True
+        try:
+            out = self.forward(*in_tracers)
+        finally:
+            _ndmod._SYMTRACE["on"] = False
+            _ag.set_recording(prev_rec)
+            _ag.set_training(prev_train)
+            _TRACE.ctx = prev
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        heads = [o._symhead for o in outs]
+        sym = Symbol(heads)
+        aux_suffixes = ("running_mean", "running_var", "moving_mean",
+                        "moving_var")
+        arg_params, aux_params = {}, {}
+        for name, p in plist:
+            if name.endswith(aux_suffixes):
+                aux_params[name] = p.data()
+            else:
+                arg_params[name] = p.data()
+        return sym, arg_params, aux_params
+
+    def export(self, path, epoch=0, *example_inputs):
+        """Reference: HybridBlock.export → ``path-symbol.json`` +
+        ``path-{epoch:04d}.params`` (deploy format, loadable by
+        SymbolBlock.imports / Module.load_checkpoint)."""
         from ..ndarray.serialization import save as _save
 
-        _save(f"{path}-{epoch:04d}.params",
-              {f"arg:{k}": v.data() for k, v in params.items()})
+        example = example_inputs or getattr(self, "_last_input_shapes", None)
+        if not example:
+            raise MXNetError(
+                "export needs an input signature: call hybridize() and run a "
+                "forward pass first, or pass example inputs — "
+                "net.export(path, epoch, x) (reference raises the same way)")
+        sym, arg_params, aux_params = self._trace_to_symbol(*example)
+        sym.save(f"{path}-symbol.json")
+        data = {f"arg:{k}": v for k, v in arg_params.items()}
+        data.update({f"aux:{k}": v for k, v in aux_params.items()})
+        _save(f"{path}-{epoch:04d}.params", data)
 
 
 class SymbolBlock(HybridBlock):
-    """Placeholder until the Symbol layer lands (phase 7, SURVEY.md §8)."""
+    """Run a Symbol graph as a Gluon block (reference: gluon.SymbolBlock —
+    python/mxnet/gluon/block.py:~1100, used to reload ``export``ed models).
 
-    def __init__(self, *a, **kw):
-        raise NotImplementedError("SymbolBlock requires the symbol layer "
-                                  "(arriving with the Module API)")
+    Execution interprets the graph with the registered jax op functions via
+    ``ndarray.apply_fn``, so autograd works through it and ``hybridize``
+    wraps it in one jit computation."""
+
+    def __init__(self, outputs, inputs, params=None, prefix=None):
+        super().__init__(prefix=prefix or "")
+        from .. import symbol as _sym
+
+        if isinstance(outputs, (list, tuple)):
+            outputs = _sym.Group(outputs)
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._sym = outputs
+        self._input_names = [s.name if hasattr(s, "name") else str(s)
+                             for s in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        self._sym_param_names = [n for n in arg_names
+                                 if n not in self._input_names] + aux_names
+        for n in self._sym_param_names:
+            grad_req = "null" if n in aux_names else "write"
+            self.params.get(n, grad_req=grad_req, allow_deferred_init=True)
+        if params:
+            for n, v in params.items():
+                key = n.replace("arg:", "").replace("aux:", "")
+                if key in self._sym_param_names:
+                    self._set_symbol_param(key, v, None)
+
+    def _set_symbol_param(self, key, value, ctx):
+        p = self.params.get(key)
+        p.shape = tuple(value.shape)
+        p.initialize(ctx=ctx, force_reinit=False)
+        p.set_data(value)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as _sym
+        from ..ndarray.serialization import load as _load
+
+        sym = _sym.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [_sym.var(n) for n in input_names]
+        blk = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            loaded = _load(param_file)
+            for k, v in loaded.items():
+                key = k.replace("arg:", "").replace("aux:", "")
+                if key in blk._sym_param_names:
+                    blk._set_symbol_param(key, v, ctx)
+        return blk
+
+    def forward(self, *args):
+        from .. import random as _rnd
+        from ..ndarray.ndarray import NDArray, apply_fn
+        from ..symbol.symbol import evaluate
+
+        heads = self._sym._heads
+        pvals = []
+        for n in self._sym_param_names:
+            pvals.append(self.params.get(n).data())
+        names = self._input_names + self._sym_param_names
+        training = _ag.is_training()
+        key = NDArray._from_jax(_rnd._next_key(), None)
+
+        def pure(key_val, *vals):
+            feed = dict(zip(names, vals))
+            outs, _ = evaluate(heads, feed, rng_key=key_val,
+                               training=training)
+            return tuple(outs) if len(outs) != 1 else outs[0]
+
+        return apply_fn(pure, [key] + list(args) + pvals, name="symbol_block")

@@ -22,7 +22,15 @@ def register(cls):
     return cls
 
 
+# reference short names (python/mxnet/metric.py registers these aliases)
+_ALIASES = {"acc": "accuracy", "ce": "crossentropy", "nll_loss":
+            "negativeloglikelihood", "top_k_accuracy": "topkaccuracy",
+            "top_k_acc": "topkaccuracy", "pearsonr": "pearsoncorrelation"}
+
+
 def create(metric, *args, **kwargs):
+    if isinstance(metric, str):
+        metric = _ALIASES.get(metric.lower(), metric)
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
     if isinstance(metric, EvalMetric):

@@ -1,0 +1,10 @@
+"""Legacy mx.model helpers (reference: python/mxnet/model.py).
+
+The FeedForward class predates even Module; what survives in real scripts is
+``save_checkpoint``/``load_checkpoint`` and ``BatchEndParam`` — provided here
+over the Module implementations.
+"""
+from .module.module import save_checkpoint, load_checkpoint
+from .module.base_module import _BatchEndParam as BatchEndParam
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]

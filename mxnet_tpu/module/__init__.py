@@ -1,0 +1,8 @@
+"""mx.mod — the legacy symbolic training API (reference:
+python/mxnet/module/)."""
+from .base_module import BaseModule
+from .module import Module, save_checkpoint, load_checkpoint
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule", "save_checkpoint",
+           "load_checkpoint"]

@@ -37,9 +37,11 @@ def _make_op_func(opname, od):
         nd_args = []
         extra = []
         for a in args:
-            if isinstance(a, NDArray) or isinstance(a, _np.ndarray) or \
+            if isinstance(a, NDArray) or type(a).__name__ == "SymbolTracer":
+                nd_args.append(a)
+            elif isinstance(a, _np.ndarray) or \
                     (hasattr(a, "shape") and hasattr(a, "dtype")):
-                nd_args.append(a if isinstance(a, NDArray) else array(a, ctx=ctx))
+                nd_args.append(array(a, ctx=ctx))
             else:
                 extra.append(a)
         ai = 0

@@ -616,6 +616,11 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
     4. wrap outputs in NDArrays
     """
     od = get_op(opname)
+    if _SYMTRACE["on"]:
+        from ..symbol.symbol import SymbolTracer, trace_invoke
+
+        if any(isinstance(a, SymbolTracer) for a in nd_args if a is not None):
+            return trace_invoke(opname, nd_args, attrs)
     attrs = {k: v for k, v in attrs.items() if v is not None or k in ("axis", "a_min", "a_max")}
     nd_args = [a for a in nd_args if a is not None]  # optional inputs omitted
     in_vals = []
@@ -663,6 +668,11 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
                 t._ag_entry = o._ag_entry
         return out
     return nd_outs if multi else nd_outs[0]
+
+
+# flag flipped by symbol-export tracing (symbol/symbol.py trace_invoke) so the
+# hot imperative path pays one dict lookup, not an isinstance sweep
+_SYMTRACE = {"on": False}
 
 
 def _call_with_attrs(fn, attrs, *arrays):

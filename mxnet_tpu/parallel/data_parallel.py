@@ -177,8 +177,13 @@ class TrainStep:
             self._param_shard = OrderedDict(
                 (k, NamedSharding(mesh, s)) for k, s in specs.items())
             self._batch_shard = NamedSharding(mesh, P(batch_axes))
+            # copy first: device_put returns the SAME buffer when the target
+            # sharding already matches (1-device mesh, replicated params), and
+            # jit donation below would then invalidate the Gluon net's own
+            # parameter buffers
             params = OrderedDict(
-                (k, jax.device_put(v, self._param_shard[k]))
+                (k, jax.device_put(jnp.array(v, copy=True),
+                                   self._param_shard[k]))
                 for k, v in params.items())
         else:
             self._param_shard = None

@@ -1,0 +1,13 @@
+"""mx.sym — the symbolic frontend (reference: python/mxnet/symbol/).
+
+The op surface is code-generated from the same registry that drives
+``mx.nd.*`` (one op table → both frontends, SURVEY.md §6.6)."""
+from __future__ import annotations
+
+from .symbol import (Symbol, var, Variable, Group, load, load_json, constant,
+                     evaluate, populate_namespace)
+
+populate_namespace(globals())
+
+zeros = globals().get("zeros")
+ones = globals().get("ones")

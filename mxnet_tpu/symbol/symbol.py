@@ -1,0 +1,911 @@
+"""Symbol: the staged-graph frontend (``mx.sym``).
+
+Reference: ``python/mxnet/symbol/symbol.py`` (~3k lines over the NNVM graph
+IR, SURVEY.md §3.5) — graph construction, composition, ``infer_shape``,
+``bind``/``simple_bind`` → Executor, JSON save/load, ``group2ctx``.
+
+TPU-native design: a Symbol is a lightweight Python DAG over the SAME op
+table that drives ``mx.nd.*`` (ops/registry.py) — there is no second kernel
+surface.  Executing a symbol interprets the DAG with the pure jax op
+functions inside ``jax.jit``, so XLA owns scheduling, fusion and memory
+planning (replacing the reference's nnvm passes: PlanMemory, inplace-addto,
+pointwise fusion).  ``infer_shape`` is ``jax.eval_shape`` over the same
+interpreter — one definition of every op's shape semantics, not two.
+
+JSON serialization mirrors the nnvm format (``nodes``/``arg_nodes``/
+``heads``, reference ``nnvm/src/pass/saveload_json.cc``) so graphs survive
+round-trips and ``SymbolBlock``/``Module.load_checkpoint`` interop works.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ops.registry import OP_TABLE, get_op
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+# aux-state naming convention (reference: BatchNorm moving_mean/moving_var are
+# auxiliary states, discovered via the op's ListAuxiliaryStates attr; here the
+# convention is carried by parameter names)
+_AUX_SUFFIXES = ("moving_mean", "moving_var", "running_mean", "running_var")
+
+# ops whose outputs write back into an aux-state input during training
+# (input index -> output index); reference: stateful FCompute mutating aux
+_STATE_OPS = {"BatchNorm": ((3, 1), (4, 2))}
+
+# parameter inputs auto-created as variables when omitted at call sites —
+# mx.sym.FullyConnected(data, num_hidden=10) materializes fc0_weight/fc0_bias
+# (reference: nnvm op ListInputNames + Symbol::Compose auto-var creation)
+_OP_PARAM_VARS = {
+    "FullyConnected": lambda a: ["weight"] + ([] if a.get("no_bias") else ["bias"]),
+    "Convolution": lambda a: ["weight"] + ([] if a.get("no_bias") else ["bias"]),
+    "Deconvolution": lambda a: ["weight"] + ([] if a.get("no_bias", True) else ["bias"]),
+    "BatchNorm": lambda a: ["gamma", "beta", "moving_mean", "moving_var"],
+    "Embedding": lambda a: ["weight"],
+    "LayerNorm": lambda a: ["gamma", "beta"],
+    "GroupNorm": lambda a: ["gamma", "beta"],
+    "InstanceNorm": lambda a: ["gamma", "beta"],
+}
+
+
+def _param_shape_hints(op, attrs, data_shape):
+    """Backward shape inference for auto-created parameter variables
+    (reference: each op's FInferShape fills unknown input shapes; jax
+    eval_shape is forward-only so the common param-bearing ops get explicit
+    hints here)."""
+    a = attrs
+    if op == "FullyConnected":
+        nh = int(a["num_hidden"])
+        in_units = (int(_np.prod(data_shape[1:])) if a.get("flatten", True)
+                    else data_shape[-1])
+        return {"weight": (nh, in_units), "bias": (nh,)}
+    if op in ("Convolution", "Deconvolution"):
+        k = a["kernel"]
+        k = (k,) if isinstance(k, int) else tuple(k)
+        nf = int(a["num_filter"])
+        g = int(a.get("num_group", 1))
+        c = data_shape[1]
+        if op == "Convolution":
+            return {"weight": (nf, c // g) + k, "bias": (nf,)}
+        return {"weight": (c, nf // g) + k, "bias": (nf,)}
+    if op == "BatchNorm":
+        c = data_shape[a.get("axis", 1)]
+        return {k: (c,) for k in ("gamma", "beta", "moving_mean", "moving_var",
+                                  "running_mean", "running_var")}
+    if op == "Embedding":
+        return {"weight": (int(a["input_dim"]), int(a["output_dim"]))}
+    if op in ("LayerNorm", "GroupNorm", "InstanceNorm"):
+        ax = a.get("axis", -1) if op == "LayerNorm" else 1
+        c = data_shape[ax]
+        return {"gamma": (c,), "beta": (c,)}
+    return {}
+
+
+# arity resolution for nout='dynamic' ops when building graphs without shapes
+_DYNAMIC_NOUT = {
+    "split": lambda attrs, nin: int(attrs.get("num_outputs", 1)),
+    "SliceChannel": lambda attrs, nin: int(attrs.get("num_outputs", 1)),
+    "slice_channel": lambda attrs, nin: int(attrs.get("num_outputs", 1)),
+    "topk": lambda attrs, nin: 2 if attrs.get("ret_typ") == "both" else 1,
+    "amp_multicast": lambda attrs, nin: nin,
+}
+
+
+class _NameManager(threading.local):
+    def __init__(self):
+        self.counters = {}
+
+    def get(self, hint):
+        hint = hint.lower()
+        n = self.counters.get(hint, 0)
+        self.counters[hint] = n + 1
+        return f"{hint}{n}"
+
+
+_NAMER = _NameManager()
+
+
+class _Node:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "nout", "value")
+
+    def __init__(self, op, name, attrs=None, inputs=(), nout=1, value=None):
+        self.op = op              # op name (str) | None for variable/constant
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs = list(inputs)  # [(Node, out_index)]
+        self.nout = nout
+        self.value = value        # constants only: a numpy array
+
+    @property
+    def is_var(self):
+        return self.op is None and self.value is None
+
+    @property
+    def is_const(self):
+        return self.op is None and self.value is not None
+
+
+def _resolve_nout(opname, attrs, nin):
+    od = get_op(opname)
+    if od.nout == "dynamic":
+        fn = _DYNAMIC_NOUT.get(opname)
+        if fn is None:
+            raise MXNetError(
+                f"op {opname!r} has dynamic arity; cannot stage symbolically")
+        return fn(attrs, nin)
+    return od.nout
+
+
+def _topo(heads):
+    """Topological order of all nodes reachable from head (node, idx) pairs."""
+    order, seen = [], set()
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for inp, _ in node.inputs:
+            visit(inp)
+        order.append(node)
+
+    for node, _ in heads:
+        visit(node)
+    return order
+
+
+class Symbol:
+    """A symbolic multi-output handle onto the staged graph."""
+
+    __slots__ = ("_heads",)
+
+    def __init__(self, heads):
+        self._heads = list(heads)   # [(node, out_index)]
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return None
+
+    def list_outputs(self):
+        outs = []
+        for node, idx in self._heads:
+            if node.nout == 1:
+                outs.append(f"{node.name}_output" if node.op else node.name)
+            else:
+                outs.append(f"{node.name}_output{idx}")
+        return outs
+
+    def list_arguments(self):
+        return [n.name for n in _topo(self._heads)
+                if n.is_var and not n.name.endswith(_AUX_SUFFIXES)]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in _topo(self._heads)
+                if n.is_var and n.name.endswith(_AUX_SUFFIXES)]
+
+    def list_inputs(self):
+        return [n.name for n in _topo(self._heads) if n.is_var]
+
+    def attr(self, key):
+        if len(self._heads) == 1:
+            v = self._heads[0][0].attrs.get(key)
+            return None if v is None else str(v)
+        return None
+
+    def attr_dict(self):
+        out = {}
+        for n in _topo(self._heads):
+            if n.attrs:
+                out[n.name] = {k: str(v) for k, v in n.attrs.items()}
+        return out
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._heads:
+            node.attrs.update(kwargs)
+
+    def get_internals(self):
+        nodes = _topo(self._heads)
+        heads = []
+        for n in nodes:
+            for i in range(n.nout):
+                heads.append((n, i))
+        return Symbol(heads)
+
+    def get_children(self):
+        kids = []
+        for node, _ in self._heads:
+            kids.extend(node.inputs)
+        return Symbol(kids) if kids else None
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            matches = [i for i, name in enumerate(self.list_outputs())
+                       if name == index or name.rsplit("_output", 1)[0] == index]
+            if not matches:
+                raise MXNetError(f"no output named {index!r}")
+            return Symbol([self._heads[matches[0]]])
+        if isinstance(index, slice):
+            return Symbol(self._heads[index])
+        return Symbol([self._heads[index]])
+
+    def __len__(self):
+        return len(self._heads)
+
+    def __iter__(self):
+        return (Symbol([h]) for h in self._heads)
+
+    def __repr__(self):
+        name = self.name
+        return f"<Symbol {name if name else 'Grouped'}>"
+
+    def __copy__(self):
+        return self.__class__(self._heads)
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # ------------------------------------------------------------------
+    # composition (reference: Symbol.__call__ / Compose)
+    # ------------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if args:
+            raise MXNetError("compose only supports keyword arguments "
+                             "(name=symbol)")
+        subst = {}
+        for k, v in kwargs.items():
+            if not isinstance(v, Symbol) or len(v._heads) != 1:
+                raise MXNetError("compose values must be single-output Symbols")
+            subst[k] = v._heads[0]
+        return Symbol([_substitute(h, subst, {}) for h in self._heads])
+
+    # ------------------------------------------------------------------
+    # shape/type inference (jax.eval_shape over the interpreter)
+    # ------------------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+        except Exception as e:
+            raise MXNetError(f"infer_shape failed: {e}") from e
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+
+        # propagate shapes node-by-node in topo order
+        shapes = dict(known)
+        nodes = _topo(self._heads)
+        for n in nodes:
+            if n.is_const:
+                shapes[n.name] = tuple(n.value.shape)
+        progressed = True
+        while progressed:
+            progressed = False
+            for n in nodes:
+                if n.op is None:
+                    continue
+                key = id(n)
+                if key in shapes:
+                    continue
+                # backward-infer auto-created param-var shapes from data shape
+                if n.op in _OP_PARAM_VARS and n.inputs:
+                    d0 = n.inputs[0][0]
+                    ds = (shapes.get(d0.name) if d0.op is None
+                          else shapes.get((id(d0), n.inputs[0][1])))
+                    if ds is not None:
+                        hints = _param_shape_hints(n.op, _clean_attrs(n.attrs), ds)
+                        for inp, _ in n.inputs[1:]:
+                            if inp.op is None and inp.name not in shapes:
+                                for pname, shp in hints.items():
+                                    if (inp.name == pname
+                                            or inp.name.endswith("_" + pname)
+                                            or inp.name.endswith("." + pname)):
+                                        shapes[inp.name] = shp
+                                        progressed = True
+                                        break
+                in_shapes = []
+                ok = True
+                for inp, idx in n.inputs:
+                    if inp.op is None:
+                        s = shapes.get(inp.name)
+                    else:
+                        s = shapes.get((id(inp), idx))
+                    if s is None:
+                        ok = False
+                        break
+                    in_shapes.append(s)
+                if not ok:
+                    continue
+                od = get_op(n.op)
+                structs = [jax.ShapeDtypeStruct(s, _np.float32)
+                           for s in in_shapes]
+                if od.needs_rng:
+                    structs = [jax.ShapeDtypeStruct((2,), _np.uint32)] + structs
+                try:
+                    out = jax.eval_shape(
+                        lambda *a: od.fn(*a, **_clean_attrs(n.attrs)), *structs)
+                except Exception as e:
+                    if partial:
+                        continue
+                    raise MXNetError(
+                        f"shape inference failed at node {n.name} ({n.op}): {e}"
+                    ) from e
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                for i, o in enumerate(outs):
+                    shapes[(id(n), i)] = tuple(o.shape)
+                shapes[key] = True
+                progressed = True
+
+        def get_shape(n, idx=0):
+            if n.op is None:
+                return shapes.get(n.name)
+            return shapes.get((id(n), idx))
+
+        arg_shapes = [shapes.get(nm) for nm in arg_names]
+        aux_shapes = [shapes.get(nm) for nm in aux_names]
+        out_shapes = [get_shape(n, i) for n, i in self._heads]
+        if not partial and any(s is None for s in arg_shapes + out_shapes):
+            # back-infer variable shapes is not supported (jax is forward
+            # only); the reference could back-propagate shapes — callers that
+            # need it must provide all input shapes
+            missing = [nm for nm, s in zip(arg_names, arg_shapes) if s is None]
+            if missing:
+                return None, None, None
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        # everything defaults to float32 unless a dtype attr says otherwise
+        arg_types = [_np.float32] * len(self.list_arguments())
+        out_types = [_np.float32] * len(self._heads)
+        aux_types = [_np.float32] * len(self.list_auxiliary_states())
+        return arg_types, out_types, aux_types
+
+    # ------------------------------------------------------------------
+    # serialization (nnvm JSON schema)
+    # ------------------------------------------------------------------
+    def tojson(self):
+        nodes = _topo(self._heads)
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes, arg_nodes = [], []
+        for i, n in enumerate(nodes):
+            entry = {"op": n.op if n.op else "null", "name": n.name,
+                     "inputs": [[nid[id(inp)], idx, 0] for inp, idx in n.inputs]}
+            attrs = {k: _attr_str(v) for k, v in n.attrs.items()}
+            if n.is_const:
+                attrs["__value__"] = json.dumps(n.value.tolist())
+                attrs["__dtype__"] = str(n.value.dtype)
+                attrs["__const__"] = "1"
+            if n.op and n.nout != 1:
+                attrs["__nout__"] = str(n.nout)
+            if attrs:
+                entry["attrs"] = attrs
+            if n.op is None:
+                arg_nodes.append(i)
+            jnodes.append(entry)
+        heads = [[nid[id(n)], idx, 0] for n, idx in self._heads]
+        return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10600],
+                                     "framework": ["str", "mxnet_tpu"]}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ------------------------------------------------------------------
+    # evaluation / binding
+    # ------------------------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        from ..ndarray import NDArray
+
+        args = {k: v for k, v in kwargs.items()}
+        ex = self.bind(ctx, args)
+        return ex.forward()
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    shared_arg_names=None, shared_exec=None,
+                    shared_buffer=None, **kwargs):
+        from ..executor import Executor
+        from ..ndarray import zeros
+
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("simple_bind needs enough shapes to infer all "
+                             f"arguments; got {kwargs}")
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        args = {}
+        shared = shared_exec.arg_dict if shared_exec is not None else None
+        for name, shape in zip(arg_names, arg_shapes):
+            if shared is not None and name in shared and name not in kwargs:
+                args[name] = shared[name]
+            else:
+                args[name] = zeros(shape, ctx=ctx)
+        aux = {}
+        shared_aux = shared_exec.aux_dict if shared_exec is not None else None
+        for name, shape in zip(aux_names, aux_shapes):
+            if shared_aux is not None and name in shared_aux:
+                aux[name] = shared_aux[name]
+            else:
+                aux[name] = zeros(shape, ctx=ctx)
+        args_grad = None
+        if grad_req != "null":
+            args_grad = {n: zeros(s, ctx=ctx)
+                         for n, s in zip(arg_names, arg_shapes)}
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux)
+
+    # ------------------------------------------------------------------
+    # operator sugar (mirrors NDArray's)
+    # ------------------------------------------------------------------
+    def _binary(self, op, other, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _sym_invoke(op, [a, b], {})
+        attrs = {"scalar": float(other), "reverse": reverse}
+        return _sym_invoke(op + "_scalar", [self], attrs)
+
+    def __add__(self, o):
+        return self._binary("broadcast_add", o)
+
+    def __radd__(self, o):
+        return self._binary("broadcast_add", o, reverse=True)
+
+    def __sub__(self, o):
+        return self._binary("broadcast_sub", o)
+
+    def __rsub__(self, o):
+        return self._binary("broadcast_sub", o, reverse=True)
+
+    def __mul__(self, o):
+        return self._binary("broadcast_mul", o)
+
+    def __rmul__(self, o):
+        return self._binary("broadcast_mul", o, reverse=True)
+
+    def __truediv__(self, o):
+        return self._binary("broadcast_div", o)
+
+    def __rtruediv__(self, o):
+        return self._binary("broadcast_div", o, reverse=True)
+
+    def __pow__(self, o):
+        return self._binary("broadcast_power", o)
+
+    def __neg__(self):
+        return _sym_invoke("negative", [self], {})
+
+    def __getstate__(self):
+        return {"json": self.tojson()}
+
+    def __setstate__(self, state):
+        self._heads = load_json(state["json"])._heads
+
+    def reshape(self, shape):
+        return _sym_invoke("reshape", [self], {"shape": shape})
+
+    def transpose(self, axes=None):
+        return _sym_invoke("transpose", [self], {"axes": axes})
+
+
+def _encode_slices(v):
+    """slice objects (from _slice_key indexing nodes) are not literals —
+    encode them as tagged tuples so JSON attrs round-trip."""
+    if isinstance(v, slice):
+        return ("__slice__", v.start, v.stop, v.step)
+    if isinstance(v, tuple):
+        return tuple(_encode_slices(x) for x in v)
+    if isinstance(v, list):
+        return [_encode_slices(x) for x in v]
+    return v
+
+
+def _decode_slices(v):
+    if isinstance(v, tuple):
+        if len(v) == 4 and v[0] == "__slice__":
+            return slice(v[1], v[2], v[3])
+        return tuple(_decode_slices(x) for x in v)
+    if isinstance(v, list):
+        return [_decode_slices(x) for x in v]
+    return v
+
+
+def _attr_str(v):
+    return repr(_encode_slices(v)) if not isinstance(v, str) else v
+
+
+def _parse_attr(s):
+    try:
+        return _decode_slices(ast.literal_eval(s))
+    except (ValueError, SyntaxError):
+        return s
+
+
+def _clean_attrs(attrs):
+    return {k: v for k, v in attrs.items() if not k.startswith("__")}
+
+
+def _substitute(head, subst, memo):
+    node, idx = head
+    if node.is_var and node.name in subst:
+        return subst[node.name]
+    if id(node) in memo:
+        return (memo[id(node)], idx)
+    if node.op is None:
+        memo[id(node)] = node
+        return (node, idx)
+    new = _Node(node.op, node.name, node.attrs,
+                [_substitute(h, subst, memo) for h in node.inputs],
+                nout=node.nout, value=node.value)
+    memo[id(node)] = new
+    return (new, idx)
+
+
+# --------------------------------------------------------------------------
+# construction API
+# --------------------------------------------------------------------------
+def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
+        lr_mult=None, wd_mult=None, **kwargs):
+    """Create a symbolic variable (reference: mx.sym.Variable)."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    attrs.update(kwargs)
+    return Symbol([(_Node(None, name, attrs), 0)])
+
+
+Variable = var
+
+
+def constant(value, name=None):
+    value = _np.asarray(value)
+    name = name or _NAMER.get("_const")
+    return Symbol([(_Node(None, name, {}, value=value), 0)])
+
+
+def Group(symbols):
+    heads = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for entry in data["nodes"]:
+        attrs = {k: _parse_attr(v) for k, v in entry.get("attrs", {}).items()}
+        op = entry["op"]
+        if op == "null":
+            if attrs.pop("__const__", None):
+                value = _np.asarray(json.loads(attrs.pop("__value__")),
+                                    dtype=attrs.pop("__dtype__", "float32"))
+                nodes.append(_Node(None, entry["name"], attrs, value=value))
+            else:
+                nodes.append(_Node(None, entry["name"], attrs))
+        else:
+            inputs = [(nodes[nid], idx) for nid, idx, _ in entry["inputs"]]
+            nout = int(attrs.pop("__nout__", 0)) or _resolve_nout(
+                op, attrs, len(inputs))
+            nodes.append(_Node(op, entry["name"], attrs, inputs, nout=nout))
+    heads = [(nodes[nid], idx) for nid, idx, _ in data["heads"]]
+    return Symbol(heads)
+
+
+# --------------------------------------------------------------------------
+# symbolic invoke — builds a graph node (the staged twin of ndarray.invoke)
+# --------------------------------------------------------------------------
+def _sym_invoke(opname, inputs, attrs, name=None):
+    od = get_op(opname)
+    attrs = {k: v for k, v in attrs.items()
+             if v is not None or k in ("axis", "a_min", "a_max")}
+    in_heads = []
+    for a in inputs:
+        if a is None:
+            continue
+        if isinstance(a, Symbol):
+            if len(a._heads) != 1:
+                raise MXNetError(
+                    f"op {opname}: grouped symbol cannot be an input")
+            in_heads.append(a._heads[0])
+        else:
+            in_heads.append(constant(a)._heads[0])
+    name = name or _NAMER.get(od.name)
+    # auto-create parameter variables for the param-bearing layer ops
+    pv = _OP_PARAM_VARS.get(od.name)
+    if pv is not None:
+        wanted = pv(attrs)
+        have = len(in_heads) - 1  # first input is data
+        for pname in wanted[max(have, 0):]:
+            in_heads.append((_Node(None, f"{name}_{pname}", {}), 0))
+    nout = _resolve_nout(od.name, attrs, len(in_heads))
+    node = _Node(od.name, name, attrs, in_heads, nout=nout)
+    if nout == 1:
+        return Symbol([(node, 0)])
+    return Symbol([(node, i) for i in range(nout)])
+
+
+# --------------------------------------------------------------------------
+# interpreter — evaluate head values given a feed dict of input values
+# --------------------------------------------------------------------------
+def evaluate(heads, feed, rng_key=None, training=False, collect_state=False):
+    """Evaluate graph heads with the registered pure jax op functions.
+
+    feed: dict name -> jax array for every variable (args + aux).
+    Returns (outputs, state_updates) where state_updates maps an aux var name
+    to its new value (BatchNorm moving stats under training).
+    """
+    import jax
+
+    vals = {}            # (id(node), idx) -> jax value
+    state_updates = {}
+    nodes = _topo(heads)
+    key_iter = [rng_key]
+
+    def next_key():
+        if key_iter[0] is None:
+            # inference path with training-only random ops (Dropout in eval
+            # mode consumes a key but ignores it) — a fixed key is sound
+            key_iter[0] = jax.random.PRNGKey(0)
+        key_iter[0], sub = jax.random.split(key_iter[0])
+        return sub
+
+    for n in nodes:
+        if n.op is None:
+            if n.is_const:
+                vals[(id(n), 0)] = n.value
+            else:
+                if n.name not in feed:
+                    raise MXNetError(f"unbound variable {n.name!r}")
+                vals[(id(n), 0)] = feed[n.name]
+            continue
+        od = get_op(n.op)
+        in_vals = [vals[(id(inp), idx)] for inp, idx in n.inputs]
+        attrs = _clean_attrs(n.attrs)
+        if training and n.op in ("BatchNorm", "Dropout"):
+            attrs["training"] = True
+        if od.needs_rng:
+            in_vals = [next_key()] + in_vals
+        out = od.fn(*in_vals, **attrs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for i, v in enumerate(outs):
+            vals[(id(n), i)] = v
+        if collect_state and training and n.op in _STATE_OPS:
+            for in_idx, out_idx in _STATE_OPS[n.op]:
+                if in_idx < len(n.inputs):
+                    aux_node = n.inputs[in_idx][0]
+                    if aux_node.op is None:
+                        state_updates[aux_node.name] = outs[out_idx]
+    outputs = [vals[(id(n), i)] for n, i in heads]
+    return outputs, state_updates
+
+
+# --------------------------------------------------------------------------
+# symbolic tracing of imperative code (the HybridBlock.export seam)
+# --------------------------------------------------------------------------
+class SymbolTracer:
+    """An NDArray-shaped proxy carrying a graph head + concrete aval.
+
+    Reference: hybridize's first-call trace passes Symbol proxies into
+    hybrid_forward (SURVEY.md §4.6).  Here imperative ``forward`` code runs
+    unmodified: ndarray.invoke diverts to graph building when it sees these."""
+
+    __slots__ = ("_symhead", "_aval", "context")
+
+    def __init__(self, head, aval, ctx=None):
+        self._symhead = head            # (node, idx)
+        self._aval = aval               # jax.ShapeDtypeStruct
+        self.context = ctx
+
+    @property
+    def shape(self):
+        return tuple(self._aval.shape)
+
+    @property
+    def dtype(self):
+        return self._aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self._aval.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._aval.shape:
+            n *= s
+        return n
+
+    def _get(self):
+        raise MXNetError(
+            "cannot read a value during symbolic export tracing — "
+            "remove asnumpy()/asscalar()/item() calls from forward()")
+
+    def asnumpy(self):
+        self._get()
+
+    # arithmetic mirrors NDArray's operator sugar, through trace_invoke
+    def _binary(self, op, other, reverse=False):
+        from ..ndarray.ndarray import NDArray
+
+        if isinstance(other, (SymbolTracer, NDArray)):
+            args = [other, self] if reverse else [self, other]
+            return trace_invoke(op, args, {})
+        return trace_invoke(op + "_scalar", [self],
+                            {"scalar": float(other), "reverse": reverse})
+
+    def __add__(self, o):
+        return self._binary("broadcast_add", o)
+
+    def __radd__(self, o):
+        return self._binary("broadcast_add", o, reverse=True)
+
+    def __sub__(self, o):
+        return self._binary("broadcast_sub", o)
+
+    def __rsub__(self, o):
+        return self._binary("broadcast_sub", o, reverse=True)
+
+    def __mul__(self, o):
+        return self._binary("broadcast_mul", o)
+
+    def __rmul__(self, o):
+        return self._binary("broadcast_mul", o, reverse=True)
+
+    def __truediv__(self, o):
+        return self._binary("broadcast_div", o)
+
+    def __rtruediv__(self, o):
+        return self._binary("broadcast_div", o, reverse=True)
+
+    def __pow__(self, o):
+        return self._binary("broadcast_power", o)
+
+    def __neg__(self):
+        return trace_invoke("negative", [self], {})
+
+    def __getitem__(self, key):
+        return trace_invoke("_slice_key", [self], {"key": key})
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return trace_invoke("reshape", [self], {"shape": tuple(shape)})
+
+    def transpose(self, axes=None):
+        return trace_invoke("transpose", [self], {"axes": axes})
+
+    def astype(self, dtype, copy=True):
+        return trace_invoke("Cast", [self], {"dtype": str(_np.dtype(dtype))})
+
+    def flatten(self):
+        return trace_invoke("flatten", [self], {})
+
+    def expand_dims(self, axis):
+        return trace_invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return trace_invoke("squeeze", [self], {"axis": axis})
+
+    def sum(self, axis=None, keepdims=False):
+        return trace_invoke("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return trace_invoke("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def __repr__(self):
+        return f"<SymbolTracer {self.shape} {self._aval.dtype}>"
+
+
+def _tracer_for(node, idx, in_avals_or_shape):
+    return SymbolTracer((node, idx), in_avals_or_shape)
+
+
+def trace_invoke(opname, args, attrs):
+    """Build a graph node from NDArray/SymbolTracer inputs during export
+    tracing, propagating concrete avals via jax.eval_shape."""
+    import jax
+
+    from ..ndarray.ndarray import NDArray
+
+    od = get_op(opname)
+    attrs = {k: v for k, v in attrs.items()
+             if v is not None or k in ("axis", "a_min", "a_max")}
+    in_heads, in_avals = [], []
+    for a in args:
+        if a is None:
+            continue
+        if isinstance(a, SymbolTracer):
+            in_heads.append(a._symhead)
+            in_avals.append(a._aval)
+        elif isinstance(a, NDArray):
+            v = _np.asarray(a.asnumpy())
+            node = _Node(None, _NAMER.get("_const"), {}, value=v)
+            in_heads.append((node, 0))
+            in_avals.append(jax.ShapeDtypeStruct(v.shape, v.dtype))
+        else:
+            v = _np.asarray(a)
+            node = _Node(None, _NAMER.get("_const"), {}, value=v)
+            in_heads.append((node, 0))
+            in_avals.append(jax.ShapeDtypeStruct(v.shape, v.dtype))
+    name = _NAMER.get(od.name)
+    structs = list(in_avals)
+    if od.needs_rng:
+        structs = [jax.random.PRNGKey(0)] + structs
+    out_aval = jax.eval_shape(lambda *xs: od.fn(*xs, **attrs), *structs)
+    multi = isinstance(out_aval, (tuple, list))
+    nout = len(out_aval) if multi else 1
+    node = _Node(od.name, name, attrs, in_heads, nout=nout)
+    if not multi:
+        return SymbolTracer((node, 0), out_aval)
+    return [SymbolTracer((node, i), av) for i, av in enumerate(out_aval)]
+
+
+def _make_symbol_function(od):
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        sym_inputs = list(args)
+        # keyword symbol inputs (mx.sym style: op(data=x, weight=w))
+        attrs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                sym_inputs.append(v)
+            else:
+                attrs[k] = v
+        return _sym_invoke(od.name, sym_inputs, attrs, name=name)
+
+    fn.__name__ = od.name
+    fn.__doc__ = (od.fn.__doc__ or "") + "\n\n(symbolic form)"
+    return fn
+
+
+def populate_namespace(ns):
+    """Code-gen the mx.sym.* op surface from the shared op table."""
+    seen = set()
+    for name, od in OP_TABLE.items():
+        if id(od) in seen and name in ns:
+            continue
+        seen.add(id(od))
+        ns[name] = _make_symbol_function(od)
+        for alias in od.aliases:
+            ns.setdefault(alias, ns[name])
+    return ns

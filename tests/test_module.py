@@ -1,0 +1,146 @@
+"""Module API tests (reference: tests/python/unittest/test_module.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.module import Module, BucketingModule
+
+
+def _mlp_sym(num_hidden=16, classes=3):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"),
+                                name="softmax", normalization="batch")
+
+
+def _toy_data(n=128, d=8, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, d) * 3
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, d).astype("f")
+    return x.astype("f"), y.astype("f")
+
+
+def test_module_bind_and_forward():
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[mx.nd.ones((4, 8))],
+                            label=[mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 3)
+    assert np.allclose(out.asnumpy().sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_module_fit_converges():
+    x, y = _toy_data()
+    train_iter = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                                   label_name="softmax_label")
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train_iter, num_epoch=12,
+            optimizer_params=(("learning_rate", 0.5),),
+            initializer=mx.init.Xavier())
+    train_iter.reset()
+    score = mod.score(train_iter, "acc")
+    acc = dict(score)["accuracy"]
+    assert acc > 0.9, f"MLP failed to fit toy data: acc={acc}"
+
+
+def test_module_predict():
+    x, y = _toy_data(n=64)
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (64, 3)
+
+
+def test_module_get_set_params():
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    arg, aux = mod.get_params()
+    assert "fc1_weight" in arg
+    arg["fc1_weight"][:] = 1.0
+    mod.set_params(arg, aux)
+    arg2, _ = mod.get_params()
+    assert np.allclose(arg2["fc1_weight"].asnumpy(), 1.0)
+
+
+def test_module_checkpoint(tmp_path):
+    x, y = _toy_data(n=32)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 3)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 3)
+    assert "fc1_weight" in arg
+    mod2 = Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (8, 8))],
+              label_shapes=[("softmax_label", (8,))])
+    b = mx.io.DataBatch(data=[mx.nd.array(x[:8])], label=[mx.nd.array(y[:8])])
+    mod.forward(b, is_train=False)
+    mod2.forward(b, is_train=False)
+    assert np.allclose(mod.get_outputs()[0].asnumpy(),
+                       mod2.get_outputs()[0].asnumpy(), atol=1e-5)
+
+
+def test_module_input_grads():
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[mx.nd.ones((4, 8))],
+                            label=[mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0]
+    assert g.shape == (4, 8)
+
+
+def _bucket_sym(seq_len):
+    # pool over the (bucketed) sequence axis so parameter shapes are
+    # bucket-independent, as in the reference's shared-param RNN buckets
+    data = mx.sym.var("data")
+    pooled = mx.sym.sum(data, axis=1, keepdims=True)
+    net = mx.sym.FullyConnected(pooled, num_hidden=4, name="fc")
+    out = mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"),
+                               name="softmax")
+    return out, ("data",), ("softmax_label",)
+
+
+def test_bucketing_module():
+    mod = BucketingModule(_bucket_sym, default_bucket_key=8, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer()
+
+    class _B:
+        pass
+
+    for L in (8, 4, 8, 4):
+        b = _B()
+        b.data = [mx.nd.ones((4, L))]
+        b.label = [mx.nd.zeros((4,))]
+        b.bucket_key = L
+        b.provide_data = [("data", (4, L))]
+        b.provide_label = [("softmax_label", (4,))]
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+        out = mod.get_outputs()[0]
+        assert out.shape == (4, 4)
+    # params stay consistent across buckets
+    arg8, _ = mod._buckets[8].get_params()
+    arg4, _ = mod._buckets[4].get_params()
+    assert np.allclose(arg8["fc_bias"].asnumpy(), arg4["fc_bias"].asnumpy())

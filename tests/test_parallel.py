@@ -138,3 +138,32 @@ def test_adam_train_step():
     for _ in range(20):
         last = float(step(x, y))
     assert last < first
+
+
+def test_trainstep_mesh_does_not_donate_net_buffers():
+    # regression: device_put may alias the net's param buffers when the
+    # sharding already matches; donation must not invalidate them
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    net = nn.Dense(4)
+    net.initialize()
+    net(mx.nd.ones((2, 3)))
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1),
+                ("dp", "fsdp", "tp"))
+
+    def loss_fn(logits, labels):
+        import jax.numpy as jnp
+
+        return jnp.square(logits).mean()
+
+    step = TrainStep(net, loss_fn, mesh=mesh, param_sharding="replicated",
+                     batch_axes=("dp", "fsdp"))
+    step(np.ones((2, 3), "f"), np.zeros((2,), "i")).block_until_ready()
+    out = net(mx.nd.ones((2, 3)))  # must not raise "buffer deleted/donated"
+    assert out.shape == (2, 4)

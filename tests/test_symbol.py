@@ -1,0 +1,169 @@
+"""Symbol frontend tests (reference: tests/python/unittest/test_symbol.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_basic_compose_and_eval():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = a + b * 2.0
+    ex = c.bind(mx.cpu(), {"a": mx.nd.ones((2, 3)),
+                           "b": mx.nd.ones((2, 3))})
+    out = ex.forward()[0]
+    assert np.allclose(out.asnumpy(), 3.0)
+
+
+def test_list_arguments_order():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    assert fc.list_arguments() == ["data", "fc_weight", "fc_bias"]
+    assert fc.list_outputs() == ["fc_output"]
+
+
+def test_auto_param_vars_no_bias():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, no_bias=True, name="fc")
+    assert fc.list_arguments() == ["data", "fc_weight"]
+
+
+def test_infer_shape_mlp():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(5, 20))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (8, 20)
+    assert d["fc2_weight"] == (3, 8)
+    assert out_shapes == [(5, 3)]
+
+
+def test_infer_shape_conv():
+    data = mx.sym.var("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16, pad=(1, 1),
+                           name="conv")
+    arg_shapes, out_shapes, _ = c.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(c.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (16, 3, 3, 3)
+    assert out_shapes == [(2, 16, 8, 8)]
+
+
+def test_batchnorm_aux_states():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    assert bn.list_arguments() == ["data", "bn_gamma", "bn_beta"]
+    assert set(bn.list_auxiliary_states()) == {"bn_moving_mean",
+                                               "bn_moving_var"}
+
+
+def test_grouped_symbol():
+    a = mx.sym.var("a")
+    s1 = a * 2.0
+    s2 = a + 1.0
+    g = mx.sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    ex = g.bind(mx.cpu(), {"a": mx.nd.ones((2,))})
+    o1, o2 = ex.forward()
+    assert np.allclose(o1.asnumpy(), 2.0)
+    assert np.allclose(o2.asnumpy(), 2.0)
+
+
+def test_json_roundtrip():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.Activation(net, act_type="tanh", name="act")
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # same numerics after roundtrip
+    feed = {"data": mx.nd.ones((2, 3)),
+            "fc_weight": mx.nd.ones((4, 3)),
+            "fc_bias": mx.nd.zeros((4,))}
+    o1 = net.bind(mx.cpu(), dict(feed)).forward()[0]
+    o2 = net2.bind(mx.cpu(), dict(feed)).forward()[0]
+    assert np.allclose(o1.asnumpy(), o2.asnumpy())
+
+
+def test_save_load_file(tmp_path):
+    net = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2, name="fc")
+    p = str(tmp_path / "sym.json")
+    net.save(p)
+    net2 = mx.sym.load(p)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_compose():
+    data = mx.sym.var("data")
+    net1 = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    data2 = mx.sym.var("data2")
+    pre = mx.sym.Activation(data2, act_type="relu", name="relu_pre")
+    composed = net1(data=pre)
+    args = composed.list_arguments()
+    assert "data2" in args and "data" not in args
+
+
+def test_get_internals():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    internals = act.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    feat = internals["fc1_output"]
+    assert feat.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_executor_backward_grads():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = a * b
+    av, bv = mx.nd.array([1.0, 2.0, 3.0]), mx.nd.array([4.0, 5.0, 6.0])
+    ex = c.bind(mx.cpu(), {"a": av, "b": bv},
+                args_grad={"a": mx.nd.zeros((3,)), "b": mx.nd.zeros((3,))})
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.ones((3,)))
+    assert np.allclose(ex.grad_dict["a"].asnumpy(), bv.asnumpy())
+    assert np.allclose(ex.grad_dict["b"].asnumpy(), av.asnumpy())
+
+
+def test_executor_grad_req_add_and_null():
+    a = mx.sym.var("a")
+    c = a * 3.0
+    av = mx.nd.array([1.0, 2.0])
+    ex = c.bind(mx.cpu(), {"a": av}, args_grad={"a": mx.nd.zeros((2,))},
+                grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.ones((2,)))
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.ones((2,)))
+    assert np.allclose(ex.grad_dict["a"].asnumpy(), 6.0)
+
+
+def test_simple_bind_softmax_training():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"), name="sm")
+    ex = out.simple_bind(mx.cpu(), data=(4, 6), softmax_label=(4,))
+    rng = np.random.RandomState(0)
+    ex.arg_dict["fc_weight"][:] = mx.nd.array(rng.randn(3, 6).astype("f"))
+    ex.forward(is_train=True, data=rng.randn(4, 6).astype("f"),
+               softmax_label=np.array([0, 1, 2, 0], dtype="f"))
+    probs = ex.outputs[0].asnumpy()
+    assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    ex.backward()
+    assert ex.grad_dict["fc_weight"].asnumpy().shape == (3, 6)
+
+
+def test_infer_shape_multi_output():
+    data = mx.sym.var("data")
+    s = mx.sym.split(data, num_outputs=2, axis=1)
+    assert len(s.list_outputs()) == 2
+    _, out_shapes, _ = s.infer_shape(data=(4, 6))
+    assert out_shapes == [(4, 3), (4, 3)]
+
+
+def test_variable_shape_attr():
+    v = mx.sym.var("x", shape=(3, 4))
+    assert v.attr("__shape__") is not None

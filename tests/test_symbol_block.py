@@ -1,0 +1,118 @@
+"""HybridBlock.export / SymbolBlock interop tests (reference:
+tests/python/unittest/test_gluon.py SymbolBlock cases)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def _net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.BatchNorm(), nn.Dense(4))
+    net.initialize()
+    return net
+
+
+def test_export_and_symbolblock_imports(tmp_path):
+    net = _net()
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 8).astype("f"))
+    y0 = net(x)
+    prefix = str(tmp_path / "model")
+    net.export(prefix, 0, x)
+    blk = gluon.SymbolBlock.imports(f"{prefix}-symbol.json", ["data"],
+                                    f"{prefix}-0000.params")
+    y1 = blk(x)
+    assert np.allclose(y0.asnumpy(), y1.asnumpy(), atol=1e-5)
+
+
+def test_export_loadable_by_module(tmp_path):
+    net = _net()
+    x = mx.nd.array(np.random.RandomState(1).randn(2, 8).astype("f"))
+    y0 = net(x)
+    prefix = str(tmp_path / "model")
+    net.export(prefix, 0, x)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
+    mod = mx.mod.Module(sym, data_names=["data"], label_names=[],
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 8))], for_training=False)
+    mod.set_params(arg, aux)
+    mod.forward(mx.io.DataBatch(data=[x]), is_train=False)
+    assert np.allclose(y0.asnumpy(), mod.get_outputs()[0].asnumpy(),
+                       atol=1e-5)
+
+
+def test_export_after_hybridize_forward(tmp_path):
+    net = _net()
+    net.hybridize()
+    x = mx.nd.ones((3, 8))
+    y0 = net(x)
+    prefix = str(tmp_path / "model")
+    net.export(prefix)  # uses remembered input shapes from the cached call
+    blk = gluon.SymbolBlock.imports(f"{prefix}-symbol.json", ["data"],
+                                    f"{prefix}-0000.params")
+    assert np.allclose(y0.asnumpy(), blk(x).asnumpy(), atol=1e-5)
+
+
+def test_autograd_through_symbolblock(tmp_path):
+    net = _net()
+    x = mx.nd.array(np.random.RandomState(2).randn(2, 8).astype("f"))
+    net(x)
+    prefix = str(tmp_path / "model")
+    net.export(prefix, 0, x)
+    blk = gluon.SymbolBlock.imports(f"{prefix}-symbol.json", ["data"],
+                                    f"{prefix}-0000.params")
+    xg = x.copy()
+    xg.attach_grad()
+    with autograd.record():
+        out = blk(xg).sum()
+    out.backward()
+    assert xg.grad.shape == (2, 8)
+    assert float(np.abs(xg.grad.asnumpy()).sum()) > 0
+
+
+def test_exported_conv_net(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(), nn.Flatten(), nn.Dense(5))
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(3).randn(2, 3, 8, 8).astype("f"))
+    y0 = net(x)
+    prefix = str(tmp_path / "conv")
+    net.export(prefix, 0, x)
+    blk = gluon.SymbolBlock.imports(f"{prefix}-symbol.json", ["data"],
+                                    f"{prefix}-0000.params")
+    assert np.allclose(y0.asnumpy(), blk(x).asnumpy(), atol=1e-4)
+
+
+def test_export_slice_and_dropout_roundtrip(tmp_path):
+    # regression: slice attrs must survive JSON; Dropout must not demand an
+    # rng key at inference (code-review findings)
+    class M(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = nn.Dense(6)
+            self.drop = nn.Dropout(0.5)
+
+        def forward(self, x):
+            return self.drop(self.d(x)[:, 0:4])
+
+    m = M()
+    m.initialize()
+    x = mx.nd.ones((2, 3))
+    y0 = m(x)
+    prefix = str(tmp_path / "s")
+    m.export(prefix, 0, x)
+    blk = gluon.SymbolBlock.imports(f"{prefix}-symbol.json", ["data"],
+                                    f"{prefix}-0000.params")
+    assert np.allclose(y0.asnumpy(), blk(x).asnumpy(), atol=1e-5)
+
+
+def test_export_without_inputs_raises():
+    net = nn.Dense(2)
+    net.initialize()
+    try:
+        net.export("/tmp/never_written")
+        assert False, "export should raise without an input signature"
+    except mx.MXNetError:
+        pass
