@@ -502,11 +502,11 @@ class HybridBlock(Block):
         outs = out if isinstance(out, (list, tuple)) else [out]
         heads = [o._symhead for o in outs]
         sym = Symbol(heads)
-        aux_suffixes = ("running_mean", "running_var", "moving_mean",
-                        "moving_var")
+        from ..symbol.symbol import _AUX_SUFFIXES
+
         arg_params, aux_params = {}, {}
         for name, p in plist:
-            if name.endswith(aux_suffixes):
+            if name.endswith(_AUX_SUFFIXES):
                 aux_params[name] = p.data()
             else:
                 arg_params[name] = p.data()
@@ -516,8 +516,6 @@ class HybridBlock(Block):
         """Reference: HybridBlock.export → ``path-symbol.json`` +
         ``path-{epoch:04d}.params`` (deploy format, loadable by
         SymbolBlock.imports / Module.load_checkpoint)."""
-        from ..ndarray.serialization import save as _save
-
         example = example_inputs or getattr(self, "_last_input_shapes", None)
         if not example:
             raise MXNetError(
@@ -525,10 +523,9 @@ class HybridBlock(Block):
                 "forward pass first, or pass example inputs — "
                 "net.export(path, epoch, x) (reference raises the same way)")
         sym, arg_params, aux_params = self._trace_to_symbol(*example)
-        sym.save(f"{path}-symbol.json")
-        data = {f"arg:{k}": v for k, v in arg_params.items()}
-        data.update({f"aux:{k}": v for k, v in aux_params.items()})
-        _save(f"{path}-{epoch:04d}.params", data)
+        from ..module.module import save_checkpoint as _save_ckpt
+
+        _save_ckpt(path, epoch, sym, arg_params, aux_params)
 
 
 class SymbolBlock(HybridBlock):

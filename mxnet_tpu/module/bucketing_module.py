@@ -79,11 +79,13 @@ class BucketingModule(BaseModule):
             self._buckets[bucket_key] = mod
             if self.params_initialized:
                 mod.params_initialized = True
-            if self.optimizer_initialized and self._opt_args is not None:
-                mod.init_optimizer(**self._opt_args)
-                # share updater state across buckets
-                mod._updater = self._buckets[self._default_bucket_key]._updater
-                mod._optimizer = self._buckets[self._default_bucket_key]._optimizer
+            if self.optimizer_initialized:
+                # share the default bucket's optimizer/updater directly —
+                # state must follow the shared params
+                base = self._buckets[self._default_bucket_key]
+                mod._optimizer = base._optimizer
+                mod._updater = base._updater
+                mod.optimizer_initialized = True
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
 
@@ -114,13 +116,13 @@ class BucketingModule(BaseModule):
                        force_init=False):
         self._opt_args = dict(kvstore=kvstore, optimizer=optimizer,
                               optimizer_params=optimizer_params)
-        for mod in self._buckets.values():
-            mod.init_optimizer(**self._opt_args)
-        # single shared updater so optimizer state follows the shared params
         base = self._buckets[self._default_bucket_key]
+        base.init_optimizer(**self._opt_args)
+        # single shared optimizer/updater so state follows the shared params
         for mod in self._buckets.values():
             mod._updater = base._updater
             mod._optimizer = base._optimizer
+            mod.optimizer_initialized = True
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):

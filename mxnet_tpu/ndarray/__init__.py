@@ -66,6 +66,13 @@ for _name in list(OP_TABLE):
         setattr(_mod, _name, _make_op_func(_name, OP_TABLE[_name]))
 
 
+
+# sub-namespaces (reference: python/mxnet/ndarray/{contrib,linalg,image}.py)
+from . import contrib  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import image  # noqa: E402,F401
+
+
 # -- convenience overrides with MXNet positional signatures ----------------
 def zeros(shape, ctx=None, dtype="float32", **kw):
     return invoke("zeros", [], {"shape": _shape_t(shape), "dtype": dtype}, ctx=ctx)

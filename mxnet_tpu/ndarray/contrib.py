@@ -1,0 +1,150 @@
+"""mx.nd.contrib — control-flow operators and contrib aliases.
+
+Reference: ``python/mxnet/ndarray/contrib.py`` (foreach/while_loop/cond
+wrappers over ``src/operator/control_flow.cc``'s stateful subgraph ops,
+SURVEY.md §3.2 "Control flow").
+
+TPU-native: the bodies are traced ONCE into ``lax.scan`` / ``lax.while_loop``
+/ ``lax.cond`` — the exact XLA structured-control-flow constructs the
+reference's subgraph CachedOps were emulating on the engine.  Autograd works
+through them because the whole loop is one ``apply_fn`` tape entry whose
+gradient is the loop's vjp (scan differentiates natively in XLA).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .ndarray import NDArray, apply_fn
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _unwrap_list(x):
+    if isinstance(x, NDArray):
+        return [x], True
+    return list(x), False
+
+
+def foreach(body, data, init_states):
+    """Scan ``body(data_t, states) -> (out_t, new_states)`` over axis 0.
+
+    Reference: mx.nd.contrib.foreach (control_flow.cc Foreach op).
+    """
+    from jax import lax
+
+    data_list, data_single = _unwrap_list(data)
+    states_list, states_single = _unwrap_list(init_states)
+    n_data = len(data_list)
+    n_states = len(states_list)
+    meta = {}
+
+    def pure(*vals):
+        data_vals = vals[:n_data]
+        state_vals = vals[n_data:]
+
+        def step(states, xs):
+            x_nd = [NDArray._from_jax(v, None) for v in xs]
+            s_nd = [NDArray._from_jax(v, None) for v in states]
+            out, new_states = body(x_nd[0] if data_single else x_nd,
+                                   s_nd[0] if states_single else s_nd)
+            out_list, out_single = _unwrap_list(out)
+            ns_list, _ = _unwrap_list(new_states)
+            meta["out_single"] = out_single
+            meta["n_out"] = len(out_list)
+            return (tuple(o._get() for o in ns_list),
+                    tuple(o._get() for o in out_list))
+
+        final_states, outs = lax.scan(step, tuple(state_vals),
+                                      tuple(data_vals))
+        return tuple(outs) + tuple(final_states)
+
+    res = apply_fn(pure, data_list + states_list, name="foreach")
+    res = res if isinstance(res, (list, tuple)) else [res]
+    n_out = meta["n_out"]
+    outs = list(res[:n_out])
+    states = list(res[n_out:])
+    out = outs[0] if meta["out_single"] else outs
+    st = states[0] if states_single else states
+    return out, st
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Reference: mx.nd.contrib.while_loop.  Runs ``func`` while ``cond``
+    holds, up to max_iterations; per-step outputs are stacked into
+    max_iterations-sized arrays (fixed shape — iterations beyond the exit
+    hold zeros), matching the reference's padded-output contract."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations (fixed shapes)")
+    vars_list, single = _unwrap_list(loop_vars)
+    nvars = len(vars_list)
+    meta = {}
+
+    def pure(*vals):
+        def to_nd(vs):
+            return [NDArray._from_jax(v, None) for v in vs]
+
+        def step(carry, _):
+            active, states = carry
+            s_nd = to_nd(states)
+            pred = cond(*s_nd)
+            pred_v = pred._get().astype(bool).reshape(())
+            active = active & pred_v
+
+            outs, new_states = func(*s_nd)
+            out_list, out_single = _unwrap_list(outs)
+            ns_list, _ = _unwrap_list(new_states)
+            meta["out_single"] = out_single
+            meta["n_out"] = len(out_list)
+            new_vals = tuple(
+                jnp.where(active, n._get(), o)
+                for n, o in zip(ns_list, states))
+            out_vals = tuple(
+                jnp.where(active, o._get(), jnp.zeros_like(o._get()))
+                for o in out_list)
+            return (active, new_vals), out_vals
+
+        (_, final), outs = lax.scan(
+            step, (jnp.asarray(True), tuple(vals)), None,
+            length=max_iterations)
+        return tuple(outs) + tuple(final)
+
+    res = apply_fn(pure, vars_list, name="while_loop")
+    res = res if isinstance(res, (list, tuple)) else [res]
+    n_out = meta["n_out"]
+    outs = list(res[:n_out])
+    states = list(res[n_out:])
+    out = outs[0] if meta["out_single"] else outs
+    st = states[0] if single else states
+    return out, st
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """Reference: mx.nd.contrib.cond.  ``pred``/branches are callables over
+    ``inputs`` (or nullary); both branches must return matching shapes."""
+    from jax import lax
+
+    inputs_list, _ = _unwrap_list(inputs) if inputs is not None else ([], True)
+    meta = {}
+
+    def pure(*vals):
+        nd_in = [NDArray._from_jax(v, None) for v in vals]
+        p = pred(*nd_in)
+        pv = p._get().astype(bool).reshape(())
+
+        def run(fn):
+            def impl(operands):
+                nd = [NDArray._from_jax(v, None) for v in operands]
+                out = fn(*nd)
+                out_list, out_single = _unwrap_list(out)
+                meta["out_single"] = out_single
+                return tuple(o._get() for o in out_list)
+
+            return impl
+
+        return lax.cond(pv, run(then_func), run(else_func), tuple(vals))
+
+    res = apply_fn(pure, inputs_list, name="cond")
+    res = res if isinstance(res, (list, tuple)) else [res]
+    return res[0] if meta["out_single"] else list(res)

@@ -1,0 +1,14 @@
+"""mx.nd.image namespace (reference: python/mxnet/ndarray/image.py over
+src/operator/image/)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops.registry import OP_TABLE
+from . import _make_op_func
+
+_mod = _sys.modules[__name__]
+for _name in list(OP_TABLE):
+    if _name.startswith("image_"):
+        setattr(_mod, _name[len("image_"):],
+                _make_op_func(_name, OP_TABLE[_name]))

@@ -1,0 +1,14 @@
+"""mx.nd.linalg namespace (reference: python/mxnet/ndarray/linalg.py over
+src/operator/tensor/la_op.cc — gemm/potrf/trsm/syrk/det/…)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops.registry import OP_TABLE
+from . import _make_op_func
+
+_mod = _sys.modules[__name__]
+for _name in list(OP_TABLE):
+    if _name.startswith("linalg_"):
+        setattr(_mod, _name[len("linalg_"):],
+                _make_op_func(_name, OP_TABLE[_name]))

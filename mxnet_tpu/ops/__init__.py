@@ -5,4 +5,6 @@ from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import image_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
 from .registry import OP_TABLE, get_op, list_ops, register  # noqa: F401

@@ -1,0 +1,385 @@
+"""Detection operator family (reference: ``src/operator/contrib/*.{cc,cu}``
+— ROIAlign/ROIPooling, bounding_box.cc (box_nms/box_iou), multibox_*.cc
+(SSD), SURVEY.md §3.2 "Detection-era contrib ops").
+
+TPU-native design: everything is FIXED-SHAPE.  The reference's NMS writes a
+variable number of survivors; here suppressed entries are overwritten with -1
+scores (exactly the reference's output convention!) so the output shape equals
+the input shape and XLA never sees a dynamic dimension — the pad-to-bucket
+discipline of SURVEY.md §6.7.  Sorting/selection use XLA's sort; ROIAlign's
+bilinear sampling is a gather + weighted sum that the MXU/VPU pipeline well.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# --------------------------------------------------------------------------
+# box utilities
+# --------------------------------------------------------------------------
+def _box_area(boxes, fmt):
+    jnp = _jnp()
+    if fmt == "corner":
+        w = jnp.maximum(boxes[..., 2] - boxes[..., 0], 0)
+        h = jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
+    else:  # center
+        w, h = boxes[..., 2], boxes[..., 3]
+    return w * h
+
+
+def _to_corner(boxes, fmt):
+    jnp = _jnp()
+    if fmt == "corner":
+        return boxes
+    x, y, w, h = (boxes[..., 0], boxes[..., 1], boxes[..., 2], boxes[..., 3])
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _pairwise_iou(a, b, fmt="corner"):
+    """IOU matrix between (..., N, 4) and (..., M, 4)."""
+    jnp = _jnp()
+    a = _to_corner(a, fmt)
+    b = _to_corner(b, fmt)
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = _box_area(a, "corner")[..., :, None]
+    area_b = _box_area(b, "corner")[..., None, :]
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, format="corner"):
+    """Reference: src/operator/contrib/bounding_box.cc box_iou."""
+    return _pairwise_iou(lhs, rhs, format)
+
+
+@register("_contrib_box_nms", aliases=("box_nms",), differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Fixed-shape NMS (reference: BoxNMS in bounding_box-inl.h).
+
+    Input (..., N, K): each row [class_id?, score, x1,y1,x2,y2, ...].
+    Output: same shape; suppressed/invalid rows have score (and id) = -1 —
+    the reference's convention, which happens to be exactly what a TPU wants
+    (no dynamic shapes).  Implemented as an O(N²) mask over the
+    score-sorted IOU matrix; N is anchor-count scale (≤ few thousand).
+    """
+    import jax
+    jnp = _jnp()
+
+    def _single(x):
+        scores = x[:, score_index]
+        boxes = x[:, coord_start:coord_start + 4]
+        valid = scores > valid_thresh
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        if topk > 0:
+            keep_topk = jnp.arange(x.shape[0]) < topk
+        else:
+            keep_topk = jnp.ones(x.shape[0], dtype=bool)
+        xs = x[order]
+        boxes_s = boxes[order]
+        valid_s = valid[order] & keep_topk
+        iou = _pairwise_iou(boxes_s, boxes_s, in_format)
+        if id_index >= 0 and not force_suppress:
+            ids = xs[:, id_index]
+            same_class = ids[:, None] == ids[None, :]
+            iou = jnp.where(same_class, iou, 0.0)
+        overlap = (iou > overlap_thresh) & valid_s[None, :]
+        tri = jnp.tril(jnp.ones_like(overlap, dtype=bool), k=-1)
+
+        def body(i, keep):
+            sup = overlap[i] & keep & (jnp.arange(keep.shape[0]) > i)
+            return jnp.where(keep[i], keep & ~sup, keep)
+
+        keep = jax.lax.fori_loop(0, x.shape[0], body, valid_s)
+        del tri
+        neg = jnp.full_like(xs[:, score_index], -1.0)
+        out = xs.at[:, score_index].set(jnp.where(keep, xs[:, score_index], neg))
+        if id_index >= 0:
+            out = out.at[:, id_index].set(
+                jnp.where(keep, out[:, id_index], neg))
+        return out
+
+    flat = data.reshape((-1,) + data.shape[-2:])
+    out = jax.vmap(_single)(flat)
+    return out.reshape(data.shape)
+
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max-pool ROI crops (reference: src/operator/roi_pooling.cc).
+    data (N,C,H,W); rois (R,5) rows [batch_idx, x1,y1,x2,y2]."""
+    return _roi_pool_impl(data, rois, pooled_size, spatial_scale, "max")
+
+
+@register("_contrib_ROIAlign", aliases=("ROIAlign", "roi_align"))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False, aligned=False):
+    """Bilinear ROI align (reference: src/operator/contrib/roi_align.cc)."""
+    import jax
+    jnp = _jnp()
+
+    if isinstance(pooled_size, int):
+        pooled_size = (pooled_size, pooled_size)
+    ph, pw = pooled_size
+    n, c, h, w = data.shape
+    sr = max(int(sample_ratio), 1)
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        bidx = roi[0].astype(_np.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale - offset, \
+            roi[2] * spatial_scale - offset, roi[3] * spatial_scale - offset, \
+            roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: (ph*sr, pw*sr) points
+        ys = y1 + (jnp.arange(ph * sr) + 0.5) * bin_h / sr
+        xs = x1 + (jnp.arange(pw * sr) + 0.5) * bin_w / sr
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(_np.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(_np.int32)
+        y0i = y0.astype(_np.int32)
+        x0i = x0.astype(_np.int32)
+        ly = jnp.clip(yy - y0, 0, 1)
+        lx = jnp.clip(xx - x0, 0, 1)
+        img = data[bidx]                               # (C,H,W)
+        v00 = img[:, y0i, x0i]
+        v01 = img[:, y0i, x1i]
+        v10 = img[:, y1i, x0i]
+        v11 = img[:, y1i, x1i]
+        val = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+               + v10 * ly * (1 - lx) + v11 * ly * lx)   # (C, ph*sr, pw*sr)
+        val = val.reshape(c, ph, sr, pw, sr)
+        return val.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+def _roi_pool_impl(data, rois, pooled_size, spatial_scale, mode):
+    import jax
+    jnp = _jnp()
+
+    if isinstance(pooled_size, int):
+        pooled_size = (pooled_size, pooled_size)
+    ph, pw = pooled_size
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(_np.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        img = data[bidx]
+        ygrid = jnp.arange(h, dtype=_np.float32)
+        xgrid = jnp.arange(w, dtype=_np.float32)
+
+        outs = []
+        for py in range(ph):
+            for px in range(pw):
+                ys = jnp.floor(y1 + py * rh / ph)
+                ye = jnp.ceil(y1 + (py + 1) * rh / ph)
+                xs = jnp.floor(x1 + px * rw / pw)
+                xe = jnp.ceil(x1 + (px + 1) * rw / pw)
+                mask = ((ygrid[:, None] >= ys) & (ygrid[:, None] < ye)
+                        & (xgrid[None, :] >= xs) & (xgrid[None, :] < xe))
+                masked = jnp.where(mask[None], img, -jnp.inf)
+                v = masked.max(axis=(1, 2))
+                outs.append(jnp.where(jnp.isfinite(v), v, 0.0))
+        return jnp.stack(outs, axis=1).reshape(c, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# --------------------------------------------------------------------------
+# SSD MultiBox family (reference: src/operator/contrib/multibox_*.cc)
+# --------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior", "multibox_prior"),
+          differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation (reference: multibox_prior.cc).  data (N,C,H,W) →
+    (1, H*W*(S+R-1), 4) corner-format anchors."""
+    jnp = _jnp()
+    h, w = data.shape[2], data.shape[3]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[1] if steps[1] > 0 else 1.0 / h
+    step_x = steps[0] if steps[0] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[1]) * step_y
+    cx = (jnp.arange(w) + offsets[0]) * step_x
+    cyy, cxx = jnp.meshgrid(cy, cx, indexing="ij")
+    # anchor list: (size, ratio) pairs — first size with all ratios, then
+    # remaining sizes with first ratio (the reference's S+R-1 convention)
+    whs = []
+    for r in ratios:
+        sr = _np.sqrt(r)
+        whs.append((sizes[0] * sr, sizes[0] / sr))
+    for s in sizes[1:]:
+        sr = _np.sqrt(ratios[0])
+        whs.append((s * sr, s / sr))
+    anchors = []
+    for aw, ah in whs:
+        anchors.append(jnp.stack([cxx - aw / 2, cyy - ah / 2,
+                                  cxx + aw / 2, cyy + ah / 2], axis=-1))
+    out = jnp.stack(anchors, axis=2).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",
+                                              "multibox_target"),
+          differentiable=False, nout=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1, negative_mining_ratio=-1,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor→GT matching + box-target encoding (multibox_target.cc).
+    anchor (1,A,4) corner; label (N,M,5) rows [cls, x1,y1,x2,y2] (cls<0 pad);
+    cls_pred (N, num_cls+1, A) unused except for shape.
+    Returns (box_target (N,A*4), box_mask (N,A*4), cls_target (N,A))."""
+    import jax
+    jnp = _jnp()
+
+    anchors = anchor.reshape(-1, 4)
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-12)
+    ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-12)
+
+    def one_sample(lbl):
+        gt_valid = lbl[:, 0] >= 0                       # (M,)
+        iou = _pairwise_iou(anchors, lbl[:, 1:5], "corner")   # (A,M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)               # (A,)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= overlap_threshold
+        # force-match: each valid gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)           # (M,)
+        forced = jnp.zeros(anchors.shape[0], dtype=bool)
+        forced = forced.at[best_anchor].set(gt_valid)
+        gt_of_forced = jnp.zeros(anchors.shape[0], dtype=_np.int32)
+        gt_of_forced = gt_of_forced.at[best_anchor].set(
+            jnp.arange(lbl.shape[0], dtype=_np.int32))
+        use_gt = jnp.where(forced, gt_of_forced, best_gt)
+        matched = matched | forced
+        g = lbl[use_gt]                                  # (A,5)
+        gcx = (g[:, 1] + g[:, 3]) / 2
+        gcy = (g[:, 2] + g[:, 4]) / 2
+        gw = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
+        gh = jnp.maximum(g[:, 4] - g[:, 2], 1e-12)
+        tx = (gcx - acx) / aw / variances[0]
+        ty = (gcy - acy) / ah / variances[1]
+        tw = jnp.log(gw / aw) / variances[2]
+        th = jnp.log(gh / ah) / variances[3]
+        box_t = jnp.stack([tx, ty, tw, th], axis=-1)    # (A,4)
+        mask = matched[:, None].astype(box_t.dtype)
+        cls_t = jnp.where(matched, g[:, 0] + 1, 0.0)    # 0 = background
+        return (box_t * mask).reshape(-1), \
+            jnp.broadcast_to(mask, box_t.shape).reshape(-1), cls_t
+
+    bt, bm, ct = jax.vmap(one_sample)(label)
+    return bt, bm, ct
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",
+                                                 "multibox_detection"),
+          differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + NMS to detections (multibox_detection.cc).
+    cls_prob (N,CLS,A); loc_pred (N,A*4); anchor (1,A,4).
+    Returns (N, A, 6) rows [cls_id, score, x1,y1,x2,y2], invalid = -1."""
+    import jax
+    jnp = _jnp()
+
+    anchors = anchor.reshape(-1, 4)
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+
+    def one(cp, lp):
+        loc = lp.reshape(-1, 4)
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = jnp.delete(cp, background_id, axis=0, assume_unique_indices=True) \
+            if hasattr(jnp, "delete") else cp[1:]
+        cls_id = jnp.argmax(fg, axis=0).astype(boxes.dtype)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        cls_id = jnp.where(keep, cls_id, -1.0)
+        score = jnp.where(keep, score, -1.0)
+        det = jnp.concatenate([cls_id[:, None], score[:, None], boxes], axis=1)
+        return det
+
+    det = jax.vmap(one)(cls_prob, loc_pred)
+    return box_nms(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+
+
+@register("_contrib_bipartite_matching", aliases=("bipartite_matching",),
+          differentiable=False, nout=2)
+def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
+    """Greedy bipartite matching (reference: bounding_box.cc
+    BipartiteMatching).  data (..., N, M) scores.  Returns (row→col match,
+    col→row match), -1 for unmatched."""
+    import jax
+    jnp = _jnp()
+
+    def single(x):
+        n, m = x.shape
+        big = jnp.inf if is_ascend else -jnp.inf
+
+        def body(_, state):
+            xm, rmatch, cmatch = state
+            flat = jnp.argmin(xm) if is_ascend else jnp.argmax(xm)
+            i, j = flat // m, flat % m
+            v = xm[i, j]
+            ok = (v < threshold) if is_ascend else (v > threshold)
+            rmatch = jnp.where(ok, rmatch.at[i].set(j.astype(_np.float32)),
+                               rmatch)
+            cmatch = jnp.where(ok, cmatch.at[j].set(i.astype(_np.float32)),
+                               cmatch)
+            xm = xm.at[i, :].set(big)
+            xm = xm.at[:, j].set(big)
+            return xm, rmatch, cmatch
+
+        rounds = min(n, m) if topk <= 0 else min(topk, min(n, m))
+        _, rmatch, cmatch = jax.lax.fori_loop(
+            0, rounds, body, (x, -jnp.ones(n), -jnp.ones(m)))
+        return rmatch, cmatch
+
+    flat = data.reshape((-1,) + data.shape[-2:])
+    r, c = jax.vmap(single)(flat)
+    return (r.reshape(data.shape[:-2] + (data.shape[-2],)),
+            c.reshape(data.shape[:-2] + (data.shape[-1],)))

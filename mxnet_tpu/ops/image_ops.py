@@ -1,0 +1,214 @@
+"""Image operator family (reference: ``src/operator/image/*.{cc,cu}`` —
+the GPU-capable Gluon transform path, SURVEY.md §3.2).
+
+TPU-native: resize is ``jax.image.resize`` (XLA gather/convolution lowering);
+color jitters are elementwise chains XLA fuses; random ops thread PRNG keys
+through the registry's needs_rng path.  Layout is CHW/NCHW-agnostic where the
+reference is (ops take either HWC or NHWC like the reference's image ops).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _hwc_axes(x):
+    """(h_axis, w_axis, c_axis) for HWC or NHWC input."""
+    if x.ndim == 3:
+        return 0, 1, 2
+    return 1, 2, 3
+
+
+@register("image_to_tensor", aliases=("to_tensor",))
+def image_to_tensor(x):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: image/totensor op)."""
+    jnp = _jnp()
+    y = x.astype(_np.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(y, (2, 0, 1))
+    return jnp.transpose(y, (0, 3, 1, 2))
+
+
+@register("image_normalize")
+def image_normalize(x, mean=0.0, std=1.0):
+    """CHW/NCHW normalize (reference: image/normalize op)."""
+    jnp = _jnp()
+    mean = jnp.asarray(mean, dtype=x.dtype)
+    std = jnp.asarray(std, dtype=x.dtype)
+    if mean.ndim == 1:
+        shape = (-1,) + (1, 1)
+        mean = mean.reshape(shape)
+        std = std.reshape(shape)
+        if x.ndim == 4:
+            mean = mean[None]
+            std = std[None]
+    return (x - mean) / std
+
+
+@register("image_resize", aliases=("resize",))
+def image_resize(x, size=None, keep_ratio=False, interp=1):
+    """HWC/NHWC resize (reference: image/resize.cc).  interp: 0 nearest,
+    1 bilinear, 2 bicubic (maps to jax.image methods)."""
+    import jax
+
+    if isinstance(size, int):
+        size = (size, size)  # (w, h) like the reference
+    w, h = size
+    method = {0: "nearest", 1: "bilinear", 2: "bicubic"}.get(interp, "bilinear")
+    if x.ndim == 3:
+        shape = (h, w, x.shape[2])
+    else:
+        shape = (x.shape[0], h, w, x.shape[3])
+    return jax.image.resize(x.astype(_np.float32), shape, method=method).astype(x.dtype)
+
+
+@register("image_crop", aliases=("crop",))
+def image_crop(x, x0=0, y0=0, width=None, height=None):
+    """Fixed crop of HWC/NHWC (reference: image/crop.cc)."""
+    if x.ndim == 3:
+        return x[y0:y0 + height, x0:x0 + width, :]
+    return x[:, y0:y0 + height, x0:x0 + width, :]
+
+
+@register("image_flip_left_right", aliases=("flip_left_right",))
+def image_flip_left_right(x):
+    jnp = _jnp()
+    _, w_ax, _ = _hwc_axes(x)
+    return jnp.flip(x, axis=w_ax)
+
+
+@register("image_flip_top_bottom", aliases=("flip_top_bottom",))
+def image_flip_top_bottom(x):
+    jnp = _jnp()
+    h_ax, _, _ = _hwc_axes(x)
+    return jnp.flip(x, axis=h_ax)
+
+
+@register("image_random_flip_left_right", aliases=("random_flip_left_right",),
+          needs_rng=True)
+def image_random_flip_left_right(key, x):
+    import jax
+    jnp = _jnp()
+    _, w_ax, _ = _hwc_axes(x)
+    return jnp.where(jax.random.bernoulli(key), jnp.flip(x, axis=w_ax), x)
+
+
+@register("image_random_flip_top_bottom", aliases=("random_flip_top_bottom",),
+          needs_rng=True)
+def image_random_flip_top_bottom(key, x):
+    import jax
+    jnp = _jnp()
+    h_ax, _, _ = _hwc_axes(x)
+    return jnp.where(jax.random.bernoulli(key), jnp.flip(x, axis=h_ax), x)
+
+
+def _blend(a, b, alpha):
+    return a * alpha + b * (1.0 - alpha)
+
+
+def _grayscale(x, c_ax):
+    jnp = _jnp()
+    weights = jnp.asarray([0.299, 0.587, 0.114], dtype=_np.float32)
+    shape = [1] * x.ndim
+    shape[c_ax] = 3
+    g = jnp.sum(x * weights.reshape(shape), axis=c_ax, keepdims=True)
+    return jnp.broadcast_to(g, x.shape)
+
+
+@register("image_random_brightness", aliases=("random_brightness",),
+          needs_rng=True)
+def image_random_brightness(key, x, min_factor=1.0, max_factor=1.0):
+    import jax
+
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return x * alpha
+
+
+@register("image_random_contrast", aliases=("random_contrast",),
+          needs_rng=True)
+def image_random_contrast(key, x, min_factor=1.0, max_factor=1.0):
+    import jax
+    jnp = _jnp()
+
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    gray_mean = jnp.mean(x)
+    return _blend(x, jnp.full_like(x, gray_mean), alpha)
+
+
+@register("image_random_saturation", aliases=("random_saturation",),
+          needs_rng=True)
+def image_random_saturation(key, x, min_factor=1.0, max_factor=1.0):
+    import jax
+
+    *_, c_ax = _hwc_axes(x)
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return _blend(x, _grayscale(x, c_ax), alpha)
+
+
+@register("image_random_hue", aliases=("random_hue",), needs_rng=True)
+def image_random_hue(key, x, min_factor=1.0, max_factor=1.0):
+    """Approximate hue jitter via the reference's YIQ rotation
+    (src/operator/image/image_random-inl.h RandomHue)."""
+    import jax
+    jnp = _jnp()
+
+    *_, c_ax = _hwc_axes(x)
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    u = jnp.cos(alpha * _np.pi)
+    w = jnp.sin(alpha * _np.pi)
+    t_yiq = jnp.asarray([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], dtype=_np.float32)
+    t_rgb = jnp.asarray([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]], dtype=_np.float32)
+    zero = jnp.zeros(())
+    rot = jnp.stack([jnp.asarray([1.0, 0.0, 0.0], dtype=_np.float32),
+                     jnp.stack([zero, u, -w]),
+                     jnp.stack([zero, w, u])])
+    m = t_rgb @ rot @ t_yiq
+    xm = jnp.moveaxis(x, c_ax, -1)
+    y = xm @ m.T
+    return jnp.moveaxis(y, -1, c_ax)
+
+
+@register("image_random_lighting", aliases=("random_lighting",),
+          needs_rng=True)
+def image_random_lighting(key, x, alpha_std=0.05):
+    """AlexNet-style PCA lighting noise (reference: RandomLighting)."""
+    import jax
+    jnp = _jnp()
+
+    *_, c_ax = _hwc_axes(x)
+    eigval = jnp.asarray([55.46, 4.794, 1.148], dtype=_np.float32)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.814],
+                          [-0.5836, -0.6948, 0.4203]], dtype=_np.float32)
+    alpha = jax.random.normal(key, (3,)) * alpha_std
+    delta = eigvec @ (alpha * eigval)
+    shape = [1] * x.ndim
+    shape[c_ax] = 3
+    return x + delta.reshape(shape)
+
+
+@register("image_random_color_jitter", aliases=("random_color_jitter",),
+          needs_rng=True)
+def image_random_color_jitter(key, x, brightness=0.0, contrast=0.0,
+                              saturation=0.0, hue=0.0):
+    import jax
+
+    k1, k2, k3 = jax.random.split(key, 3)
+    if brightness > 0:
+        x = image_random_brightness(k1, x, 1 - brightness, 1 + brightness)
+    if contrast > 0:
+        x = image_random_contrast(k2, x, 1 - contrast, 1 + contrast)
+    if saturation > 0:
+        x = image_random_saturation(k3, x, 1 - saturation, 1 + saturation)
+    return x

@@ -783,3 +783,73 @@ def amp_multicast(*arrays, num_outputs=None):
     # cast all to widest dtype among inputs (reference: amp_multicast)
     widest = _np.result_type(*[_np.dtype(a.dtype) if a.dtype != jnp.bfloat16 else _np.float32 for a in arrays])
     return tuple(a.astype(widest) for a in arrays)
+
+
+# ==========================================================================
+# misc late additions (reference: src/operator/tensor + contrib misc)
+# ==========================================================================
+@register("hard_sigmoid")
+def hard_sigmoid(x, alpha=0.2, beta=0.5):
+    jnp = _jnp()
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register("log_sigmoid")
+def log_sigmoid(x):
+    from jax import nn
+
+    return nn.log_sigmoid(x)
+
+
+@register("gelu")
+def gelu_op(x):
+    from jax import nn
+
+    return nn.gelu(x, approximate=False)
+
+
+@register("unravel_index", differentiable=False)
+def unravel_index(x, shape=None):
+    jnp = _jnp()
+    idx = jnp.unravel_index(x.astype(_np.int64), shape)
+    return jnp.stack(idx, axis=0)
+
+
+@register("ravel_multi_index", differentiable=False)
+def ravel_multi_index(x, shape=None):
+    jnp = _jnp()
+    strides = _np.concatenate([_np.cumprod(shape[::-1])[::-1][1:], [1]])
+    return jnp.sum(x * jnp.asarray(strides)[:, None], axis=0)
+
+
+@register("khatri_rao")
+def khatri_rao(*mats):
+    """Column-wise Kronecker product (reference:
+    src/operator/contrib/krprod.cc)."""
+    jnp = _jnp()
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[1])
+    return out
+
+
+@register("_contrib_index_copy", aliases=("index_copy",))
+def index_copy(old, index, new):
+    """Copy rows of ``new`` into ``old`` at ``index`` (reference:
+    src/operator/contrib/index_copy.cc)."""
+    return old.at[index.astype(_np.int32)].set(new)
+
+
+@register("_contrib_index_array", aliases=("index_array",),
+          differentiable=False)
+def index_array(data, axes=None):
+    """Per-element N-D indices (reference: src/operator/contrib/index_array.cc)."""
+    jnp = _jnp()
+    shape = data.shape
+    if axes is None:
+        axes = tuple(range(len(shape)))
+    elif isinstance(axes, int):
+        axes = (axes,)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    sel = [grids[a] for a in axes]
+    return jnp.stack(sel, axis=-1).astype(_np.int64)

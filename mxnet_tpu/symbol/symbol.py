@@ -86,6 +86,16 @@ def _param_shape_hints(op, attrs, data_shape):
     return {}
 
 
+# label-var shape back-inference for the legacy loss heads (reference: each
+# output op's FInferShape derives the label shape from the data shape)
+_LABEL_SHAPE_FROM_DATA = {
+    "SoftmaxOutput": lambda ds: tuple(ds[:-1]),
+    "LinearRegressionOutput": lambda ds: tuple(ds),
+    "LogisticRegressionOutput": lambda ds: tuple(ds),
+    "MAERegressionOutput": lambda ds: tuple(ds),
+}
+
+
 # arity resolution for nout='dynamic' ops when building graphs without shapes
 _DYNAMIC_NOUT = {
     "split": lambda attrs, nin: int(attrs.get("num_outputs", 1)),
@@ -301,6 +311,10 @@ class Symbol:
         for n in nodes:
             if n.is_const:
                 shapes[n.name] = tuple(n.value.shape)
+            elif n.is_var and n.name not in shapes:
+                declared = n.attrs.get("__shape__")
+                if declared is not None:
+                    shapes[n.name] = tuple(declared)
         progressed = True
         while progressed:
             progressed = False
@@ -310,6 +324,17 @@ class Symbol:
                 key = id(n)
                 if key in shapes:
                     continue
+                # label shapes back-infer from the data input for the legacy
+                # loss-output ops (reference: their FInferShape does this, so
+                # predict-time binds need no label_shapes)
+                if n.op in _LABEL_SHAPE_FROM_DATA and len(n.inputs) >= 2:
+                    d0, lab = n.inputs[0][0], n.inputs[1][0]
+                    ds = (shapes.get(d0.name) if d0.op is None
+                          else shapes.get((id(d0), n.inputs[0][1])))
+                    if ds is not None and lab.op is None \
+                            and lab.name not in shapes:
+                        shapes[lab.name] = _LABEL_SHAPE_FROM_DATA[n.op](ds)
+                        progressed = True
                 # backward-infer auto-created param-var shapes from data shape
                 if n.op in _OP_PARAM_VARS and n.inputs:
                     d0 = n.inputs[0][0]
@@ -658,7 +683,11 @@ def _sym_invoke(opname, inputs, attrs, name=None):
             in_heads.append((_Node(None, f"{name}_{pname}", {}), 0))
     nout = _resolve_nout(od.name, attrs, len(in_heads))
     node = _Node(od.name, name, attrs, in_heads, nout=nout)
-    if nout == 1:
+    if nout == 1 or od.name in _STATE_OPS:
+        # state ops (BatchNorm) expose only the primary output as the
+        # chainable head — the extra outputs are running-stat updates the
+        # interpreter writes back into aux states (reference: symbolic
+        # BatchNorm is single-output; moving stats are aux mutations)
         return Symbol([(node, 0)])
     return Symbol([(node, i) for i in range(nout)])
 
@@ -880,17 +909,46 @@ def trace_invoke(opname, args, attrs):
     return [SymbolTracer((node, i), av) for i, av in enumerate(out_aval)]
 
 
+def _input_slot_names(od):
+    """Ordered array-input names for keyword binding: 'data' aliases the
+    first slot; param-bearing ops use their canonical param names."""
+    import inspect
+
+    sig = [p for p in inspect.signature(od.fn).parameters.values()
+           if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                         p.VAR_POSITIONAL)]
+    names = [p.name for p in sig]
+    if od.needs_rng and names:
+        names = names[1:]
+    return names
+
+
 def _make_symbol_function(od):
     def fn(*args, **kwargs):
         name = kwargs.pop("name", None)
+        sym_kw = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
         sym_inputs = list(args)
-        # keyword symbol inputs (mx.sym style: op(data=x, weight=w))
-        attrs = {}
-        for k, v in kwargs.items():
-            if isinstance(v, Symbol):
-                sym_inputs.append(v)
+        if sym_kw:
+            # bind keyword symbol inputs by SLOT NAME, never by keyword
+            # appearance order (reference: nnvm input-name composition)
+            pv = _OP_PARAM_VARS.get(od.name)
+            order = ["data"] + pv(attrs) if pv is not None else None
+            if order is None:
+                order = _input_slot_names(od)
+                if order:
+                    order = ["data"] + order[1:]  # first slot answers 'data'
+            unresolved = [k for k in sym_kw if k not in order]
+            if unresolved and len(sym_kw) == 1:
+                sym_inputs.extend(sym_kw.values())
+            elif unresolved:
+                raise MXNetError(
+                    f"op {od.name}: cannot map keyword inputs {unresolved} "
+                    f"to input slots {order}; pass them positionally")
             else:
-                attrs[k] = v
+                for k in order:
+                    if k in sym_kw:
+                        sym_inputs.append(sym_kw[k])
         return _sym_invoke(od.name, sym_inputs, attrs, name=name)
 
     fn.__name__ = od.name

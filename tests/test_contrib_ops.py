@@ -1,0 +1,193 @@
+"""Image, detection, and control-flow operator tests (reference:
+tests/python/unittest/{test_contrib_control_flow,test_operator}.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.ndarray import contrib
+
+
+# -- image ops -------------------------------------------------------------
+def test_to_tensor_and_normalize():
+    img = np.random.randint(0, 255, (4, 6, 3)).astype("uint8")
+    t = nd.image.to_tensor(nd.array(img))
+    assert t.shape == (3, 4, 6)
+    assert np.allclose(t.asnumpy(), img.transpose(2, 0, 1) / 255.0, atol=1e-6)
+    n = nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.25, 0.25, 0.25))
+    assert np.allclose(n.asnumpy(), (img.transpose(2, 0, 1) / 255.0 - 0.5) / 0.25,
+                       atol=1e-5)
+
+
+def test_image_resize_and_flip():
+    img = nd.array(np.arange(2 * 2 * 3).reshape(2, 2, 3).astype("f"))
+    r = nd.image.resize(img, size=(4, 4))
+    assert r.shape == (4, 4, 3)
+    f = nd.image.flip_left_right(img)
+    assert np.allclose(f.asnumpy(), img.asnumpy()[:, ::-1, :])
+
+
+def test_image_random_ops_shapes():
+    mx.random.seed(0)
+    img = nd.array(np.random.rand(8, 8, 3).astype("f"))
+    for fn in (nd.image.random_flip_left_right, nd.image.random_flip_top_bottom):
+        assert fn(img).shape == img.shape
+    b = nd.image.random_brightness(img, 0.5, 1.5)
+    assert b.shape == img.shape
+    s = nd.image.random_saturation(img, 0.5, 1.5)
+    assert s.shape == img.shape
+    l = nd.image.random_lighting(img, alpha_std=0.05)
+    assert l.shape == img.shape
+
+
+# -- detection ops ---------------------------------------------------------
+def test_box_iou_values():
+    a = nd.array([[0.0, 0, 2, 2]])
+    b = nd.array([[1.0, 1, 3, 3], [0.0, 0, 2, 2], [4.0, 4, 5, 5]])
+    iou = nd.box_iou(a, b).asnumpy()
+    assert np.allclose(iou[0], [1 / 7, 1.0, 0.0], atol=1e-5)
+
+
+def test_box_nms_suppression():
+    data = np.array([[[0, 0.9, 0.10, 0.10, 0.50, 0.50],
+                      [0, 0.8, 0.12, 0.12, 0.52, 0.52],
+                      [0, 0.7, 0.60, 0.60, 0.90, 0.90]]], dtype="f")
+    out = nd.box_nms(nd.array(data), overlap_thresh=0.5, coord_start=2,
+                     score_index=1, id_index=0).asnumpy()
+    scores = out[0, :, 1]
+    # the overlapping lower-score box is suppressed (-1), others survive
+    assert scores[0] == pytest.approx(0.9)
+    assert scores[1] == -1.0
+    assert scores[2] == pytest.approx(0.7)
+
+
+def test_box_nms_class_aware():
+    # same boxes, different classes -> no suppression without force_suppress
+    data = np.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                      [1, 0.8, 0.1, 0.1, 0.5, 0.5]]], dtype="f")
+    out = nd.box_nms(nd.array(data), overlap_thresh=0.5, coord_start=2,
+                     score_index=1, id_index=0).asnumpy()
+    assert (out[0, :, 1] > 0).all()
+    out2 = nd.box_nms(nd.array(data), overlap_thresh=0.5, coord_start=2,
+                      score_index=1, id_index=0, force_suppress=True).asnumpy()
+    assert (out2[0, :, 1] == -1).sum() == 1
+
+
+def test_roi_align_uniform_image():
+    # constant image -> every pooled cell equals the constant
+    data = nd.ones((1, 3, 8, 8)) * 5.0
+    rois = nd.array([[0, 1, 1, 6, 6]], dtype="float32")
+    out = nd.ROIAlign(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 3, 2, 2)
+    assert np.allclose(out.asnumpy(), 5.0, atol=1e-5)
+
+
+def test_roi_pooling_shape():
+    data = nd.array(np.random.randn(2, 4, 8, 8).astype("f"))
+    rois = nd.array([[0, 0, 0, 4, 4], [1, 2, 2, 7, 7]], dtype="float32")
+    out = nd.ROIPooling(data, rois, pooled_size=(3, 3), spatial_scale=1.0)
+    assert out.shape == (2, 4, 3, 3)
+
+
+def test_multibox_prior_count():
+    x = nd.zeros((1, 3, 4, 4))
+    anchors = nd.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2, 0.5))
+    # S + R - 1 = 2 + 3 - 1 = 4 anchors per pixel
+    assert anchors.shape == (1, 4 * 4 * 4, 4)
+
+
+def test_multibox_target_and_detection():
+    x = nd.zeros((1, 3, 2, 2))
+    anchors = nd.MultiBoxPrior(x, sizes=(0.5,), ratios=(1,))
+    label = nd.array([[[0, 0.1, 0.1, 0.6, 0.6]]])
+    cls_pred = nd.zeros((1, 2, anchors.shape[1]))
+    bt, bm, ct = nd.MultiBoxTarget(anchors, label, cls_pred)
+    assert bt.shape == (1, anchors.shape[1] * 4)
+    assert bm.shape == bt.shape
+    assert ct.shape == (1, anchors.shape[1])
+    assert (ct.asnumpy() >= 0).all()
+    cls_prob = nd.softmax(nd.array(np.random.randn(1, 2, anchors.shape[1]).astype("f")), axis=1)
+    loc_pred = nd.zeros((1, anchors.shape[1] * 4))
+    det = nd.MultiBoxDetection(cls_prob, loc_pred, anchors)
+    assert det.shape == (1, anchors.shape[1], 6)
+
+
+def test_bipartite_matching():
+    score = nd.array([[0.9, 0.1], [0.2, 0.8]])
+    rmatch, cmatch = nd.bipartite_matching(score, threshold=0.05)
+    assert np.allclose(rmatch.asnumpy(), [0, 1])
+    assert np.allclose(cmatch.asnumpy(), [0, 1])
+
+
+# -- control flow ----------------------------------------------------------
+def test_foreach_cumsum():
+    data = nd.array(np.ones((5, 3), "f"))
+    out, state = contrib.foreach(lambda x, s: (x + s, x + s), data,
+                                 nd.zeros((3,)))
+    assert out.shape == (5, 3)
+    assert np.allclose(out.asnumpy()[-1], 5.0)
+    assert np.allclose(state.asnumpy(), 5.0)
+
+
+def test_foreach_autograd():
+    data = nd.array(np.random.randn(4, 2).astype("f"))
+    data.attach_grad()
+    with autograd.record():
+        out, state = contrib.foreach(lambda x, s: (x * 2 + s, s + x), data,
+                                     nd.zeros((2,)))
+        loss = out.sum()
+    loss.backward()
+    assert data.grad.shape == (4, 2)
+    assert float(np.abs(data.grad.asnumpy()).sum()) > 0
+
+
+def test_while_loop():
+    outs, st = contrib.while_loop(
+        lambda s: nd.array([1.0]) * (s.sum() < 5),
+        lambda s: (s, s + 1),
+        nd.zeros((2,)), max_iterations=10)
+    assert outs.shape == (10, 2)
+    assert np.allclose(st.asnumpy(), 3.0)
+
+
+def test_cond():
+    x = nd.array([1.0, 2.0])
+    r = contrib.cond(lambda a: a.sum() > 0, lambda a: a * 2, lambda a: a * 3, x)
+    assert np.allclose(r.asnumpy(), [2.0, 4.0])
+    r2 = contrib.cond(lambda a: a.sum() > 100, lambda a: a * 2, lambda a: a * 3, x)
+    assert np.allclose(r2.asnumpy(), [3.0, 6.0])
+
+
+# -- misc new ops ----------------------------------------------------------
+def test_hard_sigmoid_and_log_sigmoid():
+    x = nd.array([-10.0, 0.0, 10.0])
+    assert np.allclose(nd.hard_sigmoid(x).asnumpy(), [0, 0.5, 1], atol=1e-5)
+    assert np.allclose(nd.log_sigmoid(x).asnumpy(),
+                       np.log(1 / (1 + np.exp(-x.asnumpy()))), atol=1e-4)
+
+
+def test_khatri_rao():
+    a = np.random.randn(2, 3).astype("f")
+    b = np.random.randn(4, 3).astype("f")
+    out = nd.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+    expected = np.vstack([np.kron(a[:, i], b[:, i]).reshape(-1)
+                          for i in range(3)]).T
+    assert out.shape == (8, 3)
+    assert np.allclose(out, expected, atol=1e-5)
+
+
+def test_index_copy():
+    old = nd.zeros((4, 2))
+    new = nd.array(np.ones((2, 2), "f"))
+    idx = nd.array(np.array([1, 3], "i"))
+    out = nd.index_copy(old, idx, new).asnumpy()
+    assert np.allclose(out[[1, 3]], 1.0)
+    assert np.allclose(out[[0, 2]], 0.0)
+
+
+def test_linalg_namespace():
+    a = nd.array(np.random.randn(3, 3).astype("f"))
+    spd = nd.linalg.gemm2(a, a, transpose_b=True) + nd.array(np.eye(3, dtype="f") * 3)
+    chol = nd.linalg.potrf(spd)
+    rec = nd.linalg.gemm2(chol, chol, transpose_b=True)
+    assert np.allclose(rec.asnumpy(), spd.asnumpy(), atol=1e-3)

@@ -1,0 +1,51 @@
+"""Model zoo coverage (reference: tests/python/unittest/test_gluon_model_zoo.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+@pytest.mark.parametrize("name,size", [
+    ("alexnet", 224),
+    ("vgg11", 32),
+    ("vgg13_bn", 32),
+    ("squeezenet1_1", 64),
+    ("mobilenet0_25", 64),
+    ("mobilenet_v2_0_25", 64),
+    ("densenet121", 32),
+    ("resnet18_v1", 32),
+    ("resnet18_v2", 32),
+])
+def test_model_forward(name, size):
+    net = vision.get_model(name, classes=7)
+    net.initialize()
+    out = net(mx.nd.zeros((2, 3, size, size)))
+    assert out.shape == (2, 7)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_get_model_unknown():
+    with pytest.raises(mx.MXNetError):
+        vision.get_model("resnet9000")
+
+
+def test_inception_builds():
+    # full 299x299 forward is exercised in the TPU bench path; here just
+    # construct and check the parameter structure exists
+    net = vision.get_model("inceptionv3", classes=11)
+    net.initialize()
+    names = list(net.collect_params())
+    assert len(names) > 90
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    net = vision.get_model("mobilenet0_25", classes=5)
+    net.initialize()
+    x = mx.nd.ones((1, 3, 64, 64))
+    y0 = net(x)
+    p = str(tmp_path / "m.params")
+    net.save_parameters(p)
+    net2 = vision.get_model("mobilenet0_25", classes=5)
+    net2.load_parameters(p)
+    assert np.allclose(y0.asnumpy(), net2(x).asnumpy(), atol=1e-5)
