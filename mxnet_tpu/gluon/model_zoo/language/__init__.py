@@ -1,0 +1,9 @@
+"""Language model zoo: Llama-3 family + BERT (BASELINE configs #2 and #5)."""
+from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM, llama3_8b,
+                    llama_tiny, RMSNorm)
+from .bert import (BertConfig, BertModel, BertForPretraining, bert_base,
+                   bert_large, bert_tiny)
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama3_8b",
+           "llama_tiny", "RMSNorm", "BertConfig", "BertModel",
+           "BertForPretraining", "bert_base", "bert_large", "bert_tiny"]

@@ -1,0 +1,157 @@
+"""Llama-3-family decoder as Gluon HybridBlocks.
+
+Net-new vs the reference (MXNet 1.x predates LLMs — SURVEY.md §6.7); this is
+BASELINE config #5: "Llama-3-8B under Gluon HybridBlock, stressing
+hybridize()→HLO".  TPU-first choices: RMSNorm/RoPE/SwiGLU as registry ops
+(fp32 accumulation inside, bf16 activations outside), attention through the
+flash-attention kernel (ops/flash_attention.py), weights laid out so tp/fsdp
+sharding specs map cleanly onto the two matmul dimensions.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ...parameter import Parameter
+from ... import nn
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama3_8b",
+           "llama_tiny", "RMSNorm"]
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=128256, hidden_size=4096, num_layers=32,
+                 num_heads=32, num_kv_heads=8, intermediate_size=14336,
+                 rope_base=500000.0, max_seq_len=8192, rms_eps=1e-5,
+                 dtype="float32", tie_embeddings=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads
+        self.intermediate_size = intermediate_size
+        self.rope_base = rope_base
+        self.max_seq_len = max_seq_len
+        self.rms_eps = rms_eps
+        self.dtype = dtype
+        self.tie_embeddings = tie_embeddings
+        if hidden_size % num_heads:
+            raise MXNetError("hidden_size must divide num_heads")
+        self.head_dim = hidden_size // num_heads
+
+
+class RMSNorm(HybridBlock):
+    def __init__(self, dim, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self._eps = eps
+        self.weight = self.params.get("weight", shape=(dim,), init="ones")
+
+    def hybrid_forward(self, F, x, weight):
+        return F.rms_norm(x, weight, eps=self._eps)
+
+
+class LlamaAttention(HybridBlock):
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        d, hd = cfg.hidden_size, cfg.head_dim
+        self._cfg = cfg
+        self.q_proj = nn.Dense(cfg.num_heads * hd, use_bias=False,
+                               flatten=False, in_units=d)
+        self.k_proj = nn.Dense(cfg.num_kv_heads * hd, use_bias=False,
+                               flatten=False, in_units=d)
+        self.v_proj = nn.Dense(cfg.num_kv_heads * hd, use_bias=False,
+                               flatten=False, in_units=d)
+        self.o_proj = nn.Dense(d, use_bias=False, flatten=False,
+                               in_units=cfg.num_heads * hd)
+
+    def hybrid_forward(self, F, x):
+        cfg = self._cfg
+        b, l = x.shape[0], x.shape[1]
+        hd = cfg.head_dim
+        q = self.q_proj(x).reshape((b, l, cfg.num_heads, hd)).transpose(
+            (0, 2, 1, 3))
+        k = self.k_proj(x).reshape((b, l, cfg.num_kv_heads, hd)).transpose(
+            (0, 2, 1, 3))
+        v = self.v_proj(x).reshape((b, l, cfg.num_kv_heads, hd)).transpose(
+            (0, 2, 1, 3))
+        q = F.rope(q, base=cfg.rope_base)
+        k = F.rope(k, base=cfg.rope_base)
+        o = F.flash_attention(q, k, v, causal=True,
+                              sm_scale=1.0 / math.sqrt(hd))
+        o = o.transpose((0, 2, 1, 3)).reshape((b, l, cfg.num_heads * hd))
+        return self.o_proj(o)
+
+
+class LlamaMLP(HybridBlock):
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        self.gate_proj = nn.Dense(cfg.intermediate_size, use_bias=False,
+                                  flatten=False, in_units=cfg.hidden_size)
+        self.up_proj = nn.Dense(cfg.intermediate_size, use_bias=False,
+                                flatten=False, in_units=cfg.hidden_size)
+        self.down_proj = nn.Dense(cfg.hidden_size, use_bias=False,
+                                  flatten=False, in_units=cfg.intermediate_size)
+
+    def hybrid_forward(self, F, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(HybridBlock):
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def hybrid_forward(self, F, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class LlamaModel(HybridBlock):
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        self._cfg = cfg
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.HybridSequential(prefix="")
+        for _ in range(cfg.num_layers):
+            self.layers.add(LlamaDecoderLayer(cfg))
+        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+
+    def hybrid_forward(self, F, input_ids):
+        h = self.embed_tokens(input_ids)
+        h = self.layers(h)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(HybridBlock):
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        self._cfg = cfg
+        self.model = LlamaModel(cfg)
+        self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                flatten=False, in_units=cfg.hidden_size)
+
+    def hybrid_forward(self, F, input_ids):
+        return self.lm_head(self.model(input_ids))
+
+    @property
+    def config(self):
+        return self._cfg
+
+
+def llama3_8b(**overrides):
+    """The BASELINE config-#5 architecture (Llama-3-8B dims)."""
+    return LlamaForCausalLM(LlamaConfig(**overrides))
+
+
+def llama_tiny(**overrides):
+    """Test/bench-scale Llama (same architecture, small dims)."""
+    kw = dict(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+              num_kv_heads=2, intermediate_size=256, max_seq_len=256)
+    kw.update(overrides)
+    return LlamaForCausalLM(LlamaConfig(**kw))

@@ -1,0 +1,127 @@
+"""Transformer/NLP operator family.
+
+Reference: ``src/operator/contrib/transformer.cc`` (1.6 interleaved-matmul
+self-attention ops — a fusion, not a parallelism strategy, SURVEY.md §3.2)
+plus net-new LLM ops (RMSNorm, RoPE) required by the BASELINE Llama config.
+All pure jax; the fused-attention hot path is ops/flash_attention.py.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# --------------------------------------------------------------------------
+# interleaved-matmul attention ops (reference: transformer.cc).  Layout:
+# qkv (L, B, 3*H*D) interleaved per head — the reference's memory layout.
+# --------------------------------------------------------------------------
+def _split_interleaved(qkv, heads, n):
+    jnp = _jnp()
+    L, B, E = qkv.shape
+    d = E // (n * heads)
+    x = qkv.reshape(L, B, heads, n, d)
+    return [x[:, :, :, i, :] for i in range(n)]
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk",
+          aliases=("interleaved_matmul_selfatt_qk",))
+def interleaved_matmul_selfatt_qk(qkv, heads=1):
+    """(L,B,3HD) -> scores (B*H, L, L), scaled by 1/sqrt(d)."""
+    jnp = _jnp()
+    q, k, _ = _split_interleaved(qkv, heads, 3)
+    L, B, H, d = q.shape
+    qt = q.transpose(1, 2, 0, 3).reshape(B * H, L, d)
+    kt = k.transpose(1, 2, 0, 3).reshape(B * H, L, d)
+    return jnp.einsum("xld,xmd->xlm", qt, kt) / _np.sqrt(d)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt",
+          aliases=("interleaved_matmul_selfatt_valatt",))
+def interleaved_matmul_selfatt_valatt(qkv, att, heads=1):
+    """att (B*H,L,L) x V from qkv -> (L,B,H*D)."""
+    jnp = _jnp()
+    _, _, v = _split_interleaved(qkv, heads, 3)
+    L, B, H, d = v.shape
+    vt = v.transpose(1, 2, 0, 3).reshape(B * H, L, d)
+    out = jnp.einsum("xlm,xmd->xld", att, vt)
+    return out.reshape(B, H, L, d).transpose(2, 0, 1, 3).reshape(L, B, H * d)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk",
+          aliases=("interleaved_matmul_encdec_qk",))
+def interleaved_matmul_encdec_qk(q, kv, heads=1):
+    jnp = _jnp()
+    Lq, B, E = q.shape
+    d = E // heads
+    k, _ = _split_interleaved(kv, heads, 2)
+    Lk = k.shape[0]
+    qt = q.reshape(Lq, B, heads, d).transpose(1, 2, 0, 3).reshape(
+        B * heads, Lq, d)
+    kt = k.transpose(1, 2, 0, 3).reshape(B * heads, Lk, d)
+    return jnp.einsum("xld,xmd->xlm", qt, kt) / _np.sqrt(d)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt",
+          aliases=("interleaved_matmul_encdec_valatt",))
+def interleaved_matmul_encdec_valatt(kv, att, heads=1):
+    jnp = _jnp()
+    _, v = _split_interleaved(kv, heads, 2)
+    Lk, B, H, d = v.shape
+    Lq = att.shape[1]
+    vt = v.transpose(1, 2, 0, 3).reshape(B * H, Lk, d)
+    out = jnp.einsum("xlm,xmd->xld", att, vt)
+    return out.reshape(B, H, Lq, d).transpose(2, 0, 1, 3).reshape(Lq, B, H * d)
+
+
+# --------------------------------------------------------------------------
+# LLM building-block ops (net-new capability, BASELINE config #5)
+# --------------------------------------------------------------------------
+@register("rms_norm")
+def rms_norm(x, gamma, eps=1e-6):
+    """RMSNorm (Llama-family normalization) — fp32 accumulation."""
+    jnp = _jnp()
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    from jax import lax
+
+    y = xf * lax.rsqrt(ms + eps)
+    return (y * gamma.astype(jnp.float32)).astype(x.dtype)
+
+
+@register("rope")
+def rope(x, positions=None, base=10000.0, scale=1.0):
+    """Rotary position embedding over the last dim.
+
+    x (B, H, L, D) with D even; positions (L,) or (B, L) (defaults to
+    arange).  Half-split convention (Llama)."""
+    jnp = _jnp()
+    b, h, l, d = x.shape
+    if positions is None:
+        positions = jnp.arange(l)
+    positions = jnp.asarray(positions) * scale
+    freqs = base ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
+    angles = positions[..., None] * freqs                  # (..., L, d/2)
+    if angles.ndim == 2:        # (L, d/2): shared across batch and heads
+        angles = angles[None, None]
+    elif angles.ndim == 3:      # (B, L, d/2): per-batch, broadcast over heads
+        angles = angles[:, None]
+    cos = jnp.cos(angles).astype(x.dtype)
+    sin = jnp.sin(angles).astype(x.dtype)
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                           axis=-1)
+
+
+@register("swiglu")
+def swiglu(gate, up):
+    """SwiGLU gate: silu(gate) * up (Llama MLP)."""
+    from jax import nn
+
+    return nn.silu(gate) * up

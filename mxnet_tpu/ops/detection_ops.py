@@ -96,14 +96,12 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
             same_class = ids[:, None] == ids[None, :]
             iou = jnp.where(same_class, iou, 0.0)
         overlap = (iou > overlap_thresh) & valid_s[None, :]
-        tri = jnp.tril(jnp.ones_like(overlap, dtype=bool), k=-1)
 
         def body(i, keep):
             sup = overlap[i] & keep & (jnp.arange(keep.shape[0]) > i)
             return jnp.where(keep[i], keep & ~sup, keep)
 
         keep = jax.lax.fori_loop(0, x.shape[0], body, valid_s)
-        del tri
         neg = jnp.full_like(xs[:, score_index], -1.0)
         out = xs.at[:, score_index].set(jnp.where(keep, xs[:, score_index], neg))
         if id_index >= 0:
@@ -274,13 +272,17 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         best_gt = jnp.argmax(iou, axis=1)               # (A,)
         best_iou = jnp.max(iou, axis=1)
         matched = best_iou >= overlap_threshold
-        # force-match: each valid gt claims its best anchor
+        # force-match: each valid gt claims its best anchor; padded label
+        # rows scatter to an out-of-range index (mode='drop') so they can
+        # neither claim nor clobber a real match
         best_anchor = jnp.argmax(iou, axis=0)           # (M,)
-        forced = jnp.zeros(anchors.shape[0], dtype=bool)
-        forced = forced.at[best_anchor].set(gt_valid)
-        gt_of_forced = jnp.zeros(anchors.shape[0], dtype=_np.int32)
-        gt_of_forced = gt_of_forced.at[best_anchor].set(
-            jnp.arange(lbl.shape[0], dtype=_np.int32))
+        na = anchors.shape[0]
+        safe_anchor = jnp.where(gt_valid, best_anchor, na)
+        forced = jnp.zeros(na, dtype=bool)
+        forced = forced.at[safe_anchor].set(True, mode="drop")
+        gt_of_forced = jnp.zeros(na, dtype=_np.int32)
+        gt_of_forced = gt_of_forced.at[safe_anchor].set(
+            jnp.arange(lbl.shape[0], dtype=_np.int32), mode="drop")
         use_gt = jnp.where(forced, gt_of_forced, best_gt)
         matched = matched | forced
         g = lbl[use_gt]                                  # (A,5)

@@ -204,11 +204,13 @@ def image_random_color_jitter(key, x, brightness=0.0, contrast=0.0,
                               saturation=0.0, hue=0.0):
     import jax
 
-    k1, k2, k3 = jax.random.split(key, 3)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
     if brightness > 0:
         x = image_random_brightness(k1, x, 1 - brightness, 1 + brightness)
     if contrast > 0:
         x = image_random_contrast(k2, x, 1 - contrast, 1 + contrast)
     if saturation > 0:
         x = image_random_saturation(k3, x, 1 - saturation, 1 + saturation)
+    if hue > 0:
+        x = image_random_hue(k4, x, -hue, hue)
     return x

@@ -1,0 +1,81 @@
+"""Ring attention: context/sequence parallelism over the device mesh.
+
+Net-new vs the reference (MXNet 1.x had NO sequence/context parallelism —
+SURVEY.md §6.7); required first-class by the TPU build: sequences longer
+than one chip's HBM shard across the `sp` mesh axis, and K/V blocks rotate
+around the ICI ring (`lax.ppermute`) while each device accumulates online
+softmax — compute overlaps the ring transfer, the scaling-book recipe.
+
+Use inside `shard_map` (``ring_attention``) or via the convenience wrapper
+(``context_parallel_attention``) that builds the shard_map over a mesh.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+__all__ = ["ring_attention", "context_parallel_attention"]
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
+    """Blockwise attention with K/V ring rotation.  Call INSIDE shard_map.
+
+    q, k, v: (B, H, L_local, D) — the local sequence shard.  GQA: repeat kv
+    heads before sharding.  Returns (B, H, L_local, D).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, lloc, d = q.shape
+
+    qf = q.astype(jnp.float32) * sm_scale
+    q_pos = my * lloc + jnp.arange(lloc)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        src = (my - i) % n                      # which shard this kv block is
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32))
+        if causal:
+            k_pos = src * lloc + jnp.arange(lloc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_next, v_next), None
+
+    m0 = jnp.full((b, h, lloc), -1e30, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, lloc), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, h, lloc, d), dtype=jnp.float32)
+    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v),
+                                    jnp.arange(n))
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def context_parallel_attention(q, k, v, mesh, axis_name="sp", causal=False,
+                               sm_scale=None):
+    """Full-sequence attention with the sequence axis sharded over
+    ``axis_name``: q/k/v are unsharded (B,H,L,D) host-side arrays; the
+    shard_map splits L, rings K/V, and regathers the output."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal,
+                           sm_scale=sm_scale)
+    sharded = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)
+    return sharded(q, k, v)

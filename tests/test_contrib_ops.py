@@ -191,3 +191,13 @@ def test_linalg_namespace():
     chol = nd.linalg.potrf(spd)
     rec = nd.linalg.gemm2(chol, chol, transpose_b=True)
     assert np.allclose(rec.asnumpy(), spd.asnumpy(), atol=1e-3)
+
+
+def test_multibox_target_force_match_with_padding():
+    # regression: padded label rows must not clobber a real force-match
+    anc = nd.array(np.array([[[0.0, 0, 0.3, 0.3], [0.5, 0.5, 1, 1]]], "f"))
+    lbl = nd.array(np.array([[[1, 0.05, 0.05, 0.2, 0.2],
+                              [-1, 0, 0, 0, 0]]], "f"))
+    _, _, ct = nd.MultiBoxTarget(anc, lbl, nd.zeros((1, 3, 2)),
+                                 overlap_threshold=0.9)
+    assert ct.asnumpy()[0, 0] == 2.0  # class 1 -> target 2 (bg=0)
