@@ -39,7 +39,13 @@ class LlamaConfig:
         self.dtype = dtype
         self.tie_embeddings = tie_embeddings
         if hidden_size % num_heads:
-            raise MXNetError("hidden_size must divide num_heads")
+            raise MXNetError(
+                f"num_heads ({num_heads}) must divide hidden_size "
+                f"({hidden_size})")
+        if num_heads % num_kv_heads:
+            raise MXNetError(
+                f"num_kv_heads ({num_kv_heads}) must divide num_heads "
+                f"({num_heads}) for GQA")
         self.head_dim = hidden_size // num_heads
 
 

@@ -16,7 +16,7 @@ from .base import MXNetError
 from .ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter"]
+           "PrefetchingIter", "ImageRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -245,3 +245,136 @@ class PrefetchingIter(DataIter):
 
     def iter_next(self):
         raise NotImplementedError
+
+
+class ImageRecordIter(DataIter):
+    """Threaded image-record iterator (reference: src/io/iter_image_recordio_2.cc
+    "ImageRecordIter" — shard reader → decode pool → batcher → prefetcher).
+
+    TPU-native split: the C++ library (mxnet_tpu/native) owns file IO, record
+    framing, num_parts/part_index sharding, epoch shuffling and prefetch;
+    decode (PIL/numpy) and augmentation run here.  Supported record payloads:
+    .npy-encoded arrays (recordio.pack_img default) and JPEG/PNG via PIL.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, resize=-1, num_parts=1, part_index=0, seed=0,
+                 round_batch=True, prefetch_buffer=4, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        from .native import NativeRecordReader
+        from . import recordio as _rio
+
+        self._rio = _rio
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.mean = _np.array([mean_r, mean_g, mean_b], dtype="float32")
+        self.std = _np.array([std_r, std_g, std_b], dtype="float32")
+        self.round_batch = round_batch
+        self._rng = _np.random.RandomState(seed)
+        self._reader = NativeRecordReader(
+            path_imgrec, batch_size, num_parts=num_parts,
+            part_index=part_index, shuffle=shuffle, seed=seed,
+            queue_depth=prefetch_buffer)
+        self._data_name = data_name
+        self._label_name = label_name
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc(self._label_name, shape)]
+
+    def reset(self):
+        self._reader.reset()
+
+    def _decode(self, payload):
+        header, img = self._rio.unpack_img(payload)
+        return self._augment(img), header.label
+
+    def _augment(self, img):
+        # img HWC uint8/float -> data_shape CHW float32
+        c, h, w = self.data_shape
+        if img.ndim == 2:
+            img = img[:, :, None]
+        # reconcile channel count with data_shape: gray->RGB replicate,
+        # RGBA->drop alpha, RGB->gray luminance
+        ic = img.shape[2]
+        if ic != c:
+            if ic == 1:
+                img = _np.repeat(img, c, axis=2)
+            elif ic == 4 and c == 3:
+                img = img[:, :, :3]
+            elif c == 1:
+                img = img[:, :, :3].mean(axis=2, keepdims=True)
+            else:
+                raise MXNetError(
+                    f"record has {ic} channels but data_shape wants {c}")
+        if self.resize > 0:
+            img = self._resize_short(img, self.resize)
+        ih, iw = img.shape[:2]
+        if self.rand_crop and ih >= h and iw >= w:
+            y0 = self._rng.randint(0, ih - h + 1)
+            x0 = self._rng.randint(0, iw - w + 1)
+        else:
+            y0 = max((ih - h) // 2, 0)
+            x0 = max((iw - w) // 2, 0)
+        img = img[y0:y0 + h, x0:x0 + w]
+        if img.shape[0] != h or img.shape[1] != w:
+            img = self._resize_exact(img, h, w)
+        if self.rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1]
+        data = img.astype("float32")
+        nch = data.shape[2]
+        data = (data - self.mean[:nch]) / self.std[:nch]
+        return _np.transpose(data, (2, 0, 1))
+
+    @staticmethod
+    def _resize_short(img, size):
+        from PIL import Image
+
+        ih, iw = img.shape[:2]
+        scale = size / min(ih, iw)
+        nh, nw = int(round(ih * scale)), int(round(iw * scale))
+        return _np.asarray(Image.fromarray(img.astype("uint8")).resize(
+            (nw, nh), Image.BILINEAR))
+
+    @staticmethod
+    def _resize_exact(img, h, w):
+        from PIL import Image
+
+        return _np.asarray(Image.fromarray(img.astype("uint8")).resize(
+            (w, h), Image.BILINEAR))
+
+    def next(self):
+        from .ndarray import array as _array
+
+        payloads = self._reader.next_batch()
+        if payloads is None:
+            raise StopIteration
+        imgs, labels = [], []
+        for p in payloads:
+            img, label = self._decode(p)
+            imgs.append(img)
+            labels.append(label)
+        pad = self.batch_size - len(imgs)
+        if pad > 0 and self.round_batch:
+            # pad the tail batch with copies of the last record (reference
+            # round_batch semantics); pad count lets callers mask them
+            imgs.extend([imgs[-1]] * pad)
+            labels.extend([labels[-1]] * pad)
+        else:
+            pad = 0
+        data = _array(_np.stack(imgs))
+        label = _array(_np.asarray(labels, dtype="float32"))
+        return DataBatch(data=[data], label=[label], pad=pad)

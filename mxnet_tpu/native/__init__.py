@@ -1,0 +1,112 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Reference: MXNet's C++ data-input layer (src/io/, dmlc ThreadedIter —
+SURVEY.md §3.4).  The shared library is compiled on first use with the
+system toolchain and cached next to the source; `ctypes` is the binding
+layer (no pybind11 in this environment).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from ..base import MXNetError
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "recordio_reader.cc")
+_LIB_PATH = os.path.join(_HERE, "libmxtpu_io.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _build():
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _LIB_PATH]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        err = getattr(e, "stderr", str(e))
+        raise MXNetError(f"failed to build native IO library: {err}") from e
+
+
+def get_lib():
+    """Load (building if needed) the native IO library."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.mxtpu_reader_create.restype = ctypes.c_void_p
+        lib.mxtpu_reader_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
+        lib.mxtpu_reader_free.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_reader_num_records.restype = ctypes.c_int64
+        lib.mxtpu_reader_num_records.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_reader_reset.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.mxtpu_reader_next_batch.restype = ctypes.c_int
+        lib.mxtpu_reader_next_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        _lib = lib
+        return _lib
+
+
+class NativeRecordReader:
+    """Threaded, sharded, shuffling RecordIO batch reader (C++ backend)."""
+
+    def __init__(self, path, batch_size, num_parts=1, part_index=0,
+                 shuffle=False, seed=0, queue_depth=4):
+        self._lib = get_lib()
+        self._handle = self._lib.mxtpu_reader_create(
+            path.encode(), int(batch_size), int(num_parts), int(part_index),
+            1 if shuffle else 0, int(seed), int(queue_depth))
+        if not self._handle:
+            raise MXNetError(f"cannot open record file {path}")
+        self._epoch = 0
+
+    @property
+    def num_records(self):
+        return self._lib.mxtpu_reader_num_records(self._handle)
+
+    def reset(self):
+        self._epoch += 1
+        self._lib.mxtpu_reader_reset(self._handle, self._epoch)
+
+    def next_batch(self):
+        """Returns a list of bytes payloads, or None at end of epoch."""
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        lengths = ctypes.POINTER(ctypes.c_uint64)()
+        n = ctypes.c_uint64()
+        total = ctypes.c_uint64()
+        rc = self._lib.mxtpu_reader_next_batch(
+            self._handle, ctypes.byref(data), ctypes.byref(lengths),
+            ctypes.byref(n), ctypes.byref(total))
+        if rc != 0:
+            return None
+        out = []
+        buf = ctypes.cast(data,
+                          ctypes.POINTER(ctypes.c_uint8 * total.value))
+        raw = bytes(buf.contents) if total.value else b""
+        off = 0
+        for i in range(n.value):
+            ln = lengths[i]
+            out.append(raw[off:off + ln])
+            off += ln
+        return out
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.mxtpu_reader_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass

@@ -1,0 +1,123 @@
+"""Native C++ data pipeline tests (reference: tests for src/io/ iterators,
+SURVEY.md §3.4/§4.5)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.native import NativeRecordReader
+
+
+@pytest.fixture
+def rec_file(tmp_path):
+    rec = str(tmp_path / "data.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(37):
+        img = rng.randint(0, 255, (10, 12, 3)).astype("uint8")
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i % 5), i, 0),
+                                  img, img_fmt=".npy"))
+    w.close()
+    return rec
+
+
+def test_native_reader_counts(rec_file):
+    r = NativeRecordReader(rec_file, batch_size=8)
+    assert r.num_records == 37
+    total = 0
+    while True:
+        batch = r.next_batch()
+        if batch is None:
+            break
+        total += len(batch)
+    assert total == 37
+
+
+def test_native_reader_payloads_roundtrip(rec_file):
+    r = NativeRecordReader(rec_file, batch_size=5)
+    batch = r.next_batch()
+    header, img = recordio.unpack_img(batch[0])
+    assert img.shape == (10, 12, 3)
+    assert header.id == 0
+
+
+def test_native_reader_reset_and_shuffle(rec_file):
+    r = NativeRecordReader(rec_file, batch_size=37, shuffle=True, seed=7)
+    ids1 = [recordio.unpack(p)[0].id for p in r.next_batch()]
+    assert r.next_batch() is None
+    r.reset()
+    ids2 = [recordio.unpack(p)[0].id for p in r.next_batch()]
+    assert sorted(ids1) == list(range(37))
+    assert sorted(ids2) == list(range(37))
+    assert ids1 != ids2  # different epoch -> different order
+
+
+def test_native_reader_sharding(rec_file):
+    seen = []
+    for part in range(3):
+        r = NativeRecordReader(rec_file, batch_size=64, num_parts=3,
+                               part_index=part)
+        batch = r.next_batch() or []
+        seen.extend(recordio.unpack(p)[0].id for p in batch)
+    assert sorted(seen) == list(range(37))
+
+
+def test_image_record_iter_epoch(rec_file):
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 8, 8),
+                               batch_size=8, shuffle=True, rand_crop=True,
+                               rand_mirror=True)
+    total = 0
+    labels = []
+    for batch in it:
+        n = batch.data[0].shape[0] - (batch.pad or 0)
+        total += n
+        assert batch.data[0].shape == (8, 3, 8, 8)
+        labels.extend(batch.label[0].asnumpy()[:n].tolist())
+    assert total == 37
+    assert set(labels) == {0.0, 1.0, 2.0, 3.0, 4.0}
+    it.reset()
+    assert sum(b.data[0].shape[0] - (b.pad or 0) for b in it) == 37
+
+
+def test_image_record_iter_normalization(rec_file):
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 10, 12),
+                               batch_size=4, mean_r=128, mean_g=128,
+                               mean_b=128, std_r=64, std_g=64, std_b=64)
+    batch = next(iter(it))
+    d = batch.data[0].asnumpy()
+    assert d.min() >= -2.01 and d.max() <= 2.01
+
+
+def test_image_record_iter_feeds_module(rec_file):
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 8, 8),
+                               batch_size=8, shuffle=True)
+    data = mx.sym.var("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, num_hidden=5, name="fc")
+    sym = mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"),
+                               normalization="batch")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer_params=(("learning_rate", 0.01),))
+    assert mod.params_initialized
+
+
+def test_native_reader_bad_path_raises():
+    with pytest.raises(mx.MXNetError):
+        NativeRecordReader("/nonexistent/never.rec", batch_size=4)
+
+
+def test_image_record_iter_grayscale_shape(rec_file):
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file, data_shape=(1, 8, 8),
+                               batch_size=4)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 1, 8, 8)
+
+
+def test_image_record_iter_no_round_batch(rec_file):
+    it = mx.io.ImageRecordIter(path_imgrec=rec_file, data_shape=(3, 8, 8),
+                               batch_size=8, round_batch=False)
+    sizes = [b.data[0].shape[0] for b in it]
+    assert sizes[-1] == 37 % 8
+    assert sum(sizes) == 37

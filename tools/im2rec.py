@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""im2rec: image folder -> RecordIO dataset (reference: tools/im2rec.py).
+
+Usage:
+    python tools/im2rec.py PREFIX ROOT [--resize N] [--quality Q]
+                           [--img-fmt .jpg|.npy] [--list-only]
+
+Creates PREFIX.rec (+ PREFIX.idx, PREFIX.lst).  Class labels are assigned
+per subdirectory of ROOT, sorted (the reference's folder convention).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def build_list(root):
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    items = []
+    for label, cls in enumerate(classes):
+        for fname in sorted(os.listdir(os.path.join(root, cls))):
+            if fname.lower().endswith((".jpg", ".jpeg", ".png", ".npy")):
+                items.append((os.path.join(root, cls, fname), float(label)))
+    return items, classes
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--img-fmt", default=".jpg")
+    ap.add_argument("--list-only", action="store_true")
+    args = ap.parse_args()
+
+    items, classes = build_list(args.root)
+    with open(args.prefix + ".lst", "w") as f:
+        for i, (path, label) in enumerate(items):
+            f.write(f"{i}\t{label}\t{path}\n")
+    print(f"{len(items)} images, {len(classes)} classes")
+    if args.list_only:
+        return
+
+    writer = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                        args.prefix + ".rec", "w")
+    for i, (path, label) in enumerate(items):
+        if path.endswith(".npy"):
+            img = np.load(path)
+        else:
+            from PIL import Image
+
+            im = Image.open(path).convert("RGB")
+            if args.resize:
+                w, h = im.size
+                scale = args.resize / min(w, h)
+                im = im.resize((int(w * scale), int(h * scale)),
+                               Image.BILINEAR)
+            img = np.asarray(im)
+        header = recordio.IRHeader(0, label, i, 0)
+        packed = recordio.pack_img(header, img, quality=args.quality,
+                                   img_fmt=args.img_fmt)
+        writer.write_idx(i, packed)
+    writer.close()
+    print(f"wrote {args.prefix}.rec")
+
+
+if __name__ == "__main__":
+    main()
