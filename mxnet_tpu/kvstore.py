@@ -274,6 +274,15 @@ def _group_key_value(key, value):
 
 
 def _reduce(vals):
+    from .ndarray.sparse import RowSparseNDArray, add_rowsparse
+
+    if all(isinstance(v, RowSparseNDArray) for v in vals):
+        # sparse reduce keeps row_sparse storage: only touched rows move
+        # (reference: CommCPU rsp reduce / kvstore_dist row_sparse push)
+        acc = vals[0]
+        for v in vals[1:]:
+            acc = add_rowsparse(acc, v)
+        return acc
     if len(vals) == 1:
         return vals[0].copy()
     ctx = vals[0].context

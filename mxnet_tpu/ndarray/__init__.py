@@ -71,6 +71,16 @@ for _name in list(OP_TABLE):
 from . import contrib  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
 from . import image  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from .sparse import RowSparseNDArray, CSRNDArray  # noqa: E402
+
+
+def cast_storage(arr, stype):
+    return arr.tostype(stype)
+
+
+def sparse_retain(arr, row_ids):
+    return sparse.retain(arr, row_ids)
 
 
 # -- convenience overrides with MXNet positional signatures ----------------

@@ -593,8 +593,13 @@ class NDArray:
     def tostype(self, stype):
         if stype == "default":
             return self
-        raise NotImplementedError("sparse storage conversion lands with the "
-                                  "sparse subsystem")
+        from .sparse import RowSparseNDArray, CSRNDArray
+
+        if stype == "row_sparse":
+            return RowSparseNDArray.from_dense(self._get(), self.context)
+        if stype == "csr":
+            return CSRNDArray.from_dense(self._get(), self.context)
+        raise MXNetError(f"unknown storage type {stype!r}")
 
     def to_dlpack_for_read(self):
         return self._get().__dlpack__()

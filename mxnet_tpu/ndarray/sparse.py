@@ -1,0 +1,329 @@
+"""Sparse NDArray: row_sparse and csr storage (``mx.nd.sparse``).
+
+Reference: ``python/mxnet/ndarray/sparse.py`` (RowSparseNDArray /
+CSRNDArray, ~1.5k lines over the C++ storage-type machinery in
+include/mxnet/ndarray.h — SURVEY.md §3.1/§3.5).
+
+TPU-native design: sparse tensors are COORDINATE-STRUCTURED pairs of dense
+jax arrays (indices + values), because XLA has no native sparse layout —
+gathers/scatters over dense blocks ARE the TPU sparse idiom.  The dense
+fallback (materialize, run the dense op) mirrors the reference's own
+behavior for ops without FComputeEx.  The row_sparse path is what matters
+for BASELINE config #4: embedding-style gradients carry only touched rows
+through KVStore push/pull and optimizer updates scatter only those rows.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "zeros", "array"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class _SparseBase(NDArray):
+    """Common machinery: dense materialization through ``_get`` so every
+    dense op transparently accepts sparse inputs (reference: storage
+    fallback), while sparse-aware consumers read the compact parts."""
+
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray._from_jax(self._get(), self.context)
+        if stype == self.stype:
+            return self
+        if stype == "row_sparse":
+            return RowSparseNDArray.from_dense(self._get(), self.context)
+        if stype == "csr":
+            return CSRNDArray.from_dense(self._get(), self.context)
+        raise MXNetError(f"unknown stype {stype!r}")
+
+    def copy(self):
+        return self.tostype(self.stype)
+
+
+class RowSparseNDArray(_SparseBase):
+    """(indices (K,), values (K, *cols)) representing shape (N, *cols);
+    rows not listed are zero."""
+
+    __slots__ = ("_rs_indices", "_rs_values", "_rs_shape")
+
+    @classmethod
+    def create(cls, indices, values, shape, ctx=None):
+        jnp = _jnp()
+        self = cls._new()
+        self._rs_indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._rs_values = jnp.asarray(values)
+        self._rs_shape = tuple(shape)
+        from ..context import current_context
+
+        self._ctx = ctx or current_context()
+        self._data = None
+        return self
+
+    @classmethod
+    def from_dense(cls, dense, ctx=None):
+        jnp = _jnp()
+        dense = jnp.asarray(dense)
+        nz = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
+        idx = jnp.nonzero(nz)[0]
+        return cls.create(idx, dense[idx], dense.shape, ctx)
+
+    # -- NDArray surface ---------------------------------------------------
+    def _get(self):
+        jnp = _jnp()
+        if self._data is not None:
+            return self._data
+        dense = jnp.zeros(self._rs_shape, dtype=self._rs_values.dtype)
+        if self._rs_values.shape[0]:
+            dense = dense.at[self._rs_indices].set(self._rs_values)
+        return dense
+
+    def _set(self, value):
+        raise MXNetError("RowSparseNDArray is immutable; convert with "
+                         "tostype('default') first")
+
+    @property
+    def shape(self):
+        return self._rs_shape
+
+    @property
+    def dtype(self):
+        return self._rs_values.dtype
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return NDArray._from_jax(self._rs_indices, self._ctx)
+
+    @property
+    def data(self):
+        return NDArray._from_jax(self._rs_values, self._ctx)
+
+    def __repr__(self):
+        return (f"<RowSparseNDArray {self._rs_shape} "
+                f"({self._rs_values.shape[0]} rows stored)>")
+
+    def retain(self, row_ids):
+        """Keep only the requested rows (reference: sparse_retain op)."""
+        jnp = _jnp()
+        rid = row_ids._get() if isinstance(row_ids, NDArray) else \
+            jnp.asarray(row_ids)
+        rid = rid.astype(jnp.int32)
+        keep = jnp.isin(self._rs_indices, rid)
+        idx = _np.asarray(self._rs_indices)[_np.asarray(keep)]
+        vals = _np.asarray(self._rs_values)[_np.asarray(keep)]
+        return RowSparseNDArray.create(idx, vals, self._rs_shape, self._ctx)
+
+
+class CSRNDArray(_SparseBase):
+    """Compressed sparse row matrix: (data, indices, indptr) + 2-D shape."""
+
+    __slots__ = ("_csr_data", "_csr_indices", "_csr_indptr", "_csr_shape")
+
+    @classmethod
+    def create(cls, data, indices, indptr, shape, ctx=None):
+        jnp = _jnp()
+        self = cls._new()
+        self._csr_data = jnp.asarray(data)
+        self._csr_indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._csr_indptr = jnp.asarray(indptr, dtype=jnp.int32)
+        self._csr_shape = tuple(shape)
+        from ..context import current_context
+
+        self._ctx = ctx or current_context()
+        self._data = None
+        return self
+
+    @classmethod
+    def from_dense(cls, dense, ctx=None):
+        d = _np.asarray(dense)
+        if d.ndim != 2:
+            raise MXNetError("csr storage requires a 2-D array")
+        rows, cols = _np.nonzero(d)
+        data = d[rows, cols]
+        indptr = _np.zeros(d.shape[0] + 1, dtype=_np.int64)
+        _np.add.at(indptr, rows + 1, 1)
+        indptr = _np.cumsum(indptr)
+        return cls.create(data, cols, indptr, d.shape, ctx)
+
+    def _get(self):
+        jnp = _jnp()
+        if self._data is not None:
+            return self._data
+        n, m = self._csr_shape
+        dense = jnp.zeros((n, m), dtype=self._csr_data.dtype)
+        if self._csr_data.shape[0]:
+            counts = jnp.diff(self._csr_indptr)
+            rows = jnp.repeat(jnp.arange(n), counts,
+                              total_repeat_length=self._csr_data.shape[0])
+            dense = dense.at[rows, self._csr_indices].set(self._csr_data)
+        return dense
+
+    def _set(self, value):
+        raise MXNetError("CSRNDArray is immutable; convert with "
+                         "tostype('default') first")
+
+    @property
+    def shape(self):
+        return self._csr_shape
+
+    @property
+    def dtype(self):
+        return self._csr_data.dtype
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        return NDArray._from_jax(self._csr_data, self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray._from_jax(self._csr_indices, self._ctx)
+
+    @property
+    def indptr(self):
+        return NDArray._from_jax(self._csr_indptr, self._ctx)
+
+    def __repr__(self):
+        return (f"<CSRNDArray {self._csr_shape} "
+                f"({self._csr_data.shape[0]} stored)>")
+
+
+# --------------------------------------------------------------------------
+# constructors (reference: mx.nd.sparse.*)
+# --------------------------------------------------------------------------
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 2:
+        values, indices = arg
+        if shape is None:
+            raise MXNetError("shape required for (data, indices) input")
+        return RowSparseNDArray.create(indices, values, shape, ctx)
+    if isinstance(arg, RowSparseNDArray):
+        return arg
+    dense = arg.asnumpy() if isinstance(arg, NDArray) else _np.asarray(arg)
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    return RowSparseNDArray.from_dense(dense, ctx)
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        if shape is None:
+            raise MXNetError("shape required for (data, indices, indptr)")
+        return CSRNDArray.create(data, indices, indptr, shape, ctx)
+    if isinstance(arg, CSRNDArray):
+        return arg
+    dense = arg.asnumpy() if isinstance(arg, NDArray) else _np.asarray(arg)
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    return CSRNDArray.from_dense(dense, ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if stype == "row_sparse":
+        cols = shape[1:]
+        return RowSparseNDArray.create(
+            _np.zeros((0,), dtype=_np.int64),
+            _np.zeros((0,) + tuple(cols), dtype=dtype), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray.create(
+            _np.zeros((0,), dtype=dtype), _np.zeros((0,), dtype=_np.int64),
+            _np.zeros(shape[0] + 1, dtype=_np.int64), shape, ctx)
+    from . import zeros as _dzeros
+
+    return _dzeros(shape, ctx=ctx, dtype=dtype)
+
+
+def array(source, ctx=None, dtype=None):
+    if isinstance(source, (RowSparseNDArray, CSRNDArray)):
+        return source
+    return _dense_array(source, ctx=ctx)
+
+
+# --------------------------------------------------------------------------
+# sparse-aware helpers (reference: FComputeEx kernels)
+# --------------------------------------------------------------------------
+def add_rowsparse(a, b):
+    """Sparse-sparse add keeping row_sparse storage (reference:
+    elemwise_add FComputeEx rsp+rsp)."""
+    ai = _np.asarray(a._rs_indices)
+    bi = _np.asarray(b._rs_indices)
+    av = _np.asarray(a._rs_values)
+    bv = _np.asarray(b._rs_values)
+    union = _np.union1d(ai, bi)
+    vals = _np.zeros((len(union),) + av.shape[1:], dtype=av.dtype)
+    vals[_np.searchsorted(union, ai)] += av
+    vals[_np.searchsorted(union, bi)] += bv
+    return RowSparseNDArray.create(union, vals, a.shape, a._ctx)
+
+
+def dot_csr_dense(csr, dense, transpose_a=False):
+    """csr × dense matmul (reference: src/operator/tensor/dot.cc csr paths).
+    Stays compact: gather the needed dense rows per nonzero and segment-sum
+    — no dense materialization of the csr operand."""
+    import jax
+
+    jnp = _jnp()
+    dn = dense._get() if isinstance(dense, NDArray) else jnp.asarray(dense)
+    data = csr._csr_data
+    cols = csr._csr_indices
+    indptr = csr._csr_indptr
+    n = csr._csr_shape[0]
+    nnz = data.shape[0]
+    counts = jnp.diff(indptr)
+    rows = jnp.repeat(jnp.arange(n), counts, total_repeat_length=nnz)
+    if not transpose_a:
+        # out[r] += data * dense[col]
+        contrib = data[:, None] * dn[cols]
+        out = jax.ops.segment_sum(contrib, rows, num_segments=n)
+    else:
+        # out[col] += data * dense[row]  (shape (m, k))
+        contrib = data[:, None] * dn[rows]
+        out = jax.ops.segment_sum(contrib, cols,
+                                  num_segments=csr._csr_shape[1])
+    return NDArray._from_jax(out, csr._ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Storage-dispatching dot (reference: mx.nd.sparse.dot)."""
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, _SparseBase):
+        if transpose_b:
+            raise MXNetError("transpose_b unsupported for csr dot")
+        return dot_csr_dense(lhs, rhs, transpose_a=transpose_a)
+    from . import dot as _dense_dot
+
+    return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                      transpose_b=transpose_b)
+
+
+def cast_storage(arr, stype):
+    """Reference: src/operator/tensor/cast_storage.cc."""
+    return arr.tostype(stype)
+
+
+def retain(arr, row_ids):
+    """Reference: sparse_retain op."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("retain requires a RowSparseNDArray")
+    return arr.retain(row_ids)

@@ -68,6 +68,15 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    def update_row_sparse(self, index, weight, grad, state):
+        """Row-sparse gradient update.  Base: densify and run the dense
+        kernel (reference: ops without a sparse FComputeEx fall back);
+        SGD/AdaGrad override with lazy row-scatter updates."""
+        from ..ndarray.ndarray import NDArray as _ND
+
+        dense = _ND._from_jax(grad._get(), weight.context)
+        self.update(index, weight, dense, state)
+
     def update_multi_precision(self, index, weight, grad, state):
         self.update(index, weight, grad, state)
 
@@ -160,6 +169,31 @@ class SGD(Optimizer):
                                     dict(momentum=self.momentum, **kw))
             weight._set(new_w._get())
             state._set(new_mom._get())
+
+    def update_row_sparse(self, index, weight, grad, state):
+        """Lazy update: only rows present in the gradient change (reference:
+        sgd_update FComputeEx with lazy_update=True — the sparse-embedding
+        training path, SURVEY.md §3.2 optimizer row)."""
+        if not self.lazy_update:
+            return super().update_row_sparse(index, weight, grad, state)
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        idx = grad._rs_indices
+        g = grad._rs_values * self.rescale_grad
+        if self.clip_gradient is not None:
+            import jax.numpy as jnp
+
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w = weight._get()
+        rows = w[idx]
+        g = g + wd * rows
+        if state is None:
+            weight._set(w.at[idx].add(-lr * g))
+        else:
+            m = state._get()
+            new_m_rows = self.momentum * m[idx] - lr * g
+            state._set(m.at[idx].set(new_m_rows))
+            weight._set(w.at[idx].add(new_m_rows))
 
 
 @register
@@ -535,7 +569,14 @@ class Updater:
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
             self.states_synced[index] = True
-        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray):
+            self.optimizer.update_row_sparse(index, weight, grad,
+                                             self.states[index])
+        else:
+            self.optimizer.update_multi_precision(index, weight, grad,
+                                                  self.states[index])
 
     def get_states(self, dump_optimizer=False):
         states = {k: (v.asnumpy() if hasattr(v, "asnumpy") else

@@ -1,0 +1,162 @@
+"""Sparse NDArray tests (reference: tests/python/unittest/
+{test_sparse_ndarray,test_sparse_operator}.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray.sparse import (RowSparseNDArray, CSRNDArray,
+                                      row_sparse_array, csr_matrix,
+                                      add_rowsparse, dot as sparse_dot)
+
+
+def test_row_sparse_roundtrip():
+    dense = np.zeros((6, 3), "f")
+    dense[1] = 1.0
+    dense[4] = 2.0
+    rsp = nd.array(dense).tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert sorted(rsp.indices.asnumpy().tolist()) == [1, 4]
+    assert np.allclose(rsp.asnumpy(), dense)
+    back = rsp.tostype("default")
+    assert back.stype == "default"
+    assert np.allclose(back.asnumpy(), dense)
+
+
+def test_row_sparse_from_parts():
+    rsp = row_sparse_array((np.ones((2, 3), "f"), [0, 5]), shape=(8, 3))
+    d = rsp.asnumpy()
+    assert d.shape == (8, 3)
+    assert np.allclose(d[[0, 5]], 1.0)
+    assert np.allclose(d[[1, 2, 3, 4, 6, 7]], 0.0)
+
+
+def test_csr_roundtrip():
+    dense = np.zeros((4, 5), "f")
+    dense[0, 1] = 3.0
+    dense[2, 4] = 5.0
+    dense[2, 0] = 1.0
+    csr = nd.array(dense).tostype("csr")
+    assert csr.stype == "csr"
+    assert np.allclose(csr.asnumpy(), dense)
+    assert csr.data.shape == (3,)
+    assert csr.indptr.asnumpy().tolist() == [0, 1, 1, 3, 3]
+
+
+def test_csr_from_parts():
+    csr = csr_matrix((np.array([1.0, 2.0], "f"), [0, 2], [0, 1, 2]),
+                     shape=(2, 4))
+    d = csr.asnumpy()
+    assert d[0, 0] == 1.0 and d[1, 2] == 2.0
+    assert d.sum() == 3.0
+
+
+def test_sparse_retain():
+    rsp = row_sparse_array((np.arange(6, dtype="f").reshape(3, 2),
+                            [1, 3, 5]), shape=(8, 2))
+    kept = nd.sparse_retain(rsp, nd.array([1, 5]))
+    assert sorted(kept.indices.asnumpy().tolist()) == [1, 5]
+    d = kept.asnumpy()
+    assert np.allclose(d[3], 0.0)
+    assert np.allclose(d[1], [0, 1])
+
+
+def test_add_rowsparse():
+    a = row_sparse_array((np.ones((2, 2), "f"), [0, 2]), shape=(5, 2))
+    b = row_sparse_array((np.ones((2, 2), "f") * 2, [2, 4]), shape=(5, 2))
+    c = add_rowsparse(a, b)
+    assert c.stype == "row_sparse"
+    assert sorted(c.indices.asnumpy().tolist()) == [0, 2, 4]
+    d = c.asnumpy()
+    assert np.allclose(d[0], 1.0) and np.allclose(d[2], 3.0) \
+        and np.allclose(d[4], 2.0)
+
+
+def test_csr_dot_dense():
+    rng = np.random.RandomState(0)
+    dense_lhs = (rng.rand(6, 8) * (rng.rand(6, 8) > 0.7)).astype("f")
+    rhs = rng.randn(8, 3).astype("f")
+    csr = nd.array(dense_lhs).tostype("csr")
+    out = sparse_dot(csr, nd.array(rhs))
+    assert np.allclose(out.asnumpy(), dense_lhs @ rhs, atol=1e-5)
+    outT = sparse_dot(csr, nd.array(rng.randn(6, 3).astype("f")),
+                      transpose_a=True)
+    assert outT.shape == (8, 3)
+
+
+def test_dense_op_accepts_sparse_fallback():
+    rsp = row_sparse_array((np.ones((1, 3), "f"), [1]), shape=(4, 3))
+    out = nd.sum(rsp)
+    assert float(out.asscalar()) == 3.0
+
+
+def test_sgd_lazy_row_sparse_update():
+    from mxnet_tpu import optimizer as opt
+
+    w = nd.array(np.ones((6, 2), "f"))
+    grad = row_sparse_array((np.ones((2, 2), "f"), [1, 4]), shape=(6, 2))
+    updater = opt.get_updater(opt.create("sgd", learning_rate=0.5))
+    updater(0, grad, w)
+    d = w.asnumpy()
+    assert np.allclose(d[[1, 4]], 0.5)   # updated rows
+    assert np.allclose(d[[0, 2, 3, 5]], 1.0)  # untouched rows
+
+
+def test_sgd_momentum_row_sparse_update():
+    from mxnet_tpu import optimizer as opt
+
+    w = nd.array(np.ones((4, 2), "f"))
+    grad = row_sparse_array((np.ones((1, 2), "f"), [2]), shape=(4, 2))
+    updater = opt.get_updater(opt.create("sgd", learning_rate=0.1,
+                                         momentum=0.9))
+    updater(0, grad, w)
+    updater(0, grad, w)
+    d = w.asnumpy()
+    assert np.allclose(d[[0, 1, 3]], 1.0)
+    assert d[2, 0] < 1.0 - 2 * 0.1  # momentum accelerates
+
+
+def test_adam_row_sparse_fallback():
+    from mxnet_tpu import optimizer as opt
+
+    w = nd.array(np.ones((4, 2), "f"))
+    grad = row_sparse_array((np.ones((1, 2), "f"), [0]), shape=(4, 2))
+    updater = opt.get_updater(opt.create("adam", learning_rate=0.1))
+    updater(0, grad, w)
+    assert w.asnumpy()[0, 0] < 1.0
+
+
+def test_kvstore_row_sparse_push_pull():
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.zeros((8, 4)))
+    g1 = row_sparse_array((np.ones((2, 4), "f"), [0, 3]), shape=(8, 4))
+    g2 = row_sparse_array((np.ones((2, 4), "f"), [3, 6]), shape=(8, 4))
+    kv.push("emb", [g1, g2])
+    out = nd.zeros((8, 4))
+    kv.pull("emb", out=out)
+    d = out.asnumpy()
+    assert np.allclose(d[3], 2.0)
+    assert np.allclose(d[0], 1.0) and np.allclose(d[6], 1.0)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.array(np.arange(12, dtype="f").reshape(6, 2)))
+    out = nd.zeros((2, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 4]))
+    assert np.allclose(out.asnumpy(), [[2, 3], [8, 9]])
+
+
+def test_kvstore_sparse_update_on_kvstore():
+    from mxnet_tpu import optimizer as opt
+
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.array(np.ones((6, 2), "f")))
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.5))
+    grad = row_sparse_array((np.ones((2, 2), "f"), [1, 4]), shape=(6, 2))
+    kv.push("emb", grad)
+    out = nd.zeros((6, 2))
+    kv.pull("emb", out=out)
+    d = out.asnumpy()
+    assert np.allclose(d[[1, 4]], 0.5)
+    assert np.allclose(d[[0, 2, 3, 5]], 1.0)
