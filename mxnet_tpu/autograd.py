@@ -116,18 +116,25 @@ class Entry:
 
 
 class Node:
-    """One recorded op: pure fn + input entries + vjp residuals."""
+    """One recorded op: pure fn + input entries + vjp residuals.
+
+    ``fn``/``in_vals`` are kept so the tape can be *replayed* as a pure jax
+    function for ``grad(create_graph=True)`` (vjp-of-vjp — the reference's
+    higher-order autograd, tests/python/unittest/test_higher_order_grad.py)."""
 
     __slots__ = ("vjp_fn", "in_entries", "out_entries", "out_avals", "name",
-                 "multi")
+                 "multi", "fn", "in_vals")
 
-    def __init__(self, vjp_fn, in_entries, out_avals, name="", multi=False):
+    def __init__(self, vjp_fn, in_entries, out_avals, name="", multi=False,
+                 fn=None, in_vals=None):
         self.vjp_fn = vjp_fn
         self.in_entries = in_entries  # list[Entry|None], aligned with vjp cotangent outputs
         self.out_entries = []         # filled by record_op
         self.out_avals = out_avals    # list[(shape, dtype)]
         self.name = name
         self.multi = multi            # original fn returned a tuple
+        self.fn = fn                  # pure forward fn (attrs closed over)
+        self.in_vals = in_vals        # input snapshot for replay
 
 
 def record_op(fn, in_vals, in_entries, name=""):
@@ -143,7 +150,8 @@ def record_op(fn, in_vals, in_entries, name=""):
     multi = isinstance(out_vals, (tuple, list))
     outs = list(out_vals) if multi else [out_vals]
     node = Node(vjp_fn, list(in_entries),
-                [(o.shape, o.dtype) for o in outs], name=name, multi=multi)
+                [(o.shape, o.dtype) for o in outs], name=name, multi=multi,
+                fn=fn, in_vals=list(in_vals))
     node.out_entries = [Entry(node=node, oidx=i, shape=o.shape, dtype=o.dtype)
                         for i, o in enumerate(outs)]
     return out_vals, node.out_entries, multi
@@ -252,7 +260,11 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             )
         cotan_in = node.vjp_fn(tuple(outs) if node.multi else outs[0])
         if not retain_graph:
-            node.vjp_fn = None  # free residuals
+            # free residuals AND the replay snapshot — both pin forward
+            # activations in device memory
+            node.vjp_fn = None
+            node.fn = None
+            node.in_vals = None
         for e, c in zip(node.in_entries, cotan_in):
             if e is None or c is None:
                 continue
@@ -284,16 +296,52 @@ def _accum_grad(entry, c, written):
         written.add(id(var))
 
 
+def _replay_fn(head_entries, var_entries, head_vals):
+    """Build a pure jax function var_vals -> head_vals by replaying the tape
+    (the functional rebuild of the recorded graph that makes the gradient
+    itself re-differentiable — reference: the nnvm Gradient pass emits a
+    symbolic grad graph that can be differentiated again)."""
+    nodes = list(reversed(_topo_nodes(head_entries)))  # forward topo order
+    var_ids = [id(e) for e in var_entries]
+
+    def replay(*var_vals):
+        val_of = dict(zip(var_ids, var_vals))
+        for node in nodes:
+            ins = []
+            for e, stored in zip(node.in_entries, node.in_vals):
+                if e is not None and id(e) in val_of:
+                    ins.append(val_of[id(e)])
+                else:
+                    ins.append(stored)
+            if node.fn is None:
+                raise MXNetError(
+                    f"tape for node {node.name!r} was freed; pass "
+                    "retain_graph=True on the earlier backward")
+            outs = node.fn(*ins)
+            outs_l = list(outs) if node.multi else [outs]
+            for oe, ov in zip(node.out_entries, outs_l):
+                val_of[id(oe)] = ov
+        return tuple(
+            val_of.get(id(he), hv) for he, hv in zip(head_entries, head_vals))
+
+    return replay
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Functional gradient: returns grads of heads w.r.t. variables without
-    touching ``.grad`` buffers (reference: mx.autograd.grad)."""
-    if create_graph:
-        raise NotImplementedError("create_graph=True (higher-order imperative "
-                                  "grad) is not supported yet; use nd.grad_fn "
-                                  "or hybridize + jax.grad composition")
+    touching ``.grad`` buffers (reference: mx.autograd.grad).
+
+    ``create_graph=True`` returns gradients that are themselves on the tape,
+    enabling grad-of-grad (reference: test_higher_order_grad.py): the tape is
+    replayed as a pure jax function and its vjp application is recorded as
+    one taped op, so a further backward() differentiates through it
+    (vjp-of-vjp).
+    """
     if not isinstance(variables, (list, tuple)):
         variables = [variables]
+    if create_graph:
+        return _grad_create_graph(heads, variables, head_grads)
     from .ndarray import ndarray as _ndm
     saved = [(v._grad, v._ag_entry) for v in variables]
     try:
@@ -304,6 +352,61 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     finally:
         for v, (g, e) in zip(variables, saved):
             v._grad, v._ag_entry = g, e
+
+
+def _grad_create_graph(heads, variables, head_grads=None):
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray import ndarray as _ndm
+
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    head_entries = []
+    head_vals = []
+    for h in heads:
+        if h._ag_entry is None:
+            raise MXNetError(
+                "cannot differentiate a head that was not computed under "
+                "autograd.record() from marked variables")
+        head_entries.append(h._ag_entry)
+        head_vals.append(h._get())
+    var_entries = []
+    for v in variables:
+        if v._ag_entry is None:
+            raise MXNetError(
+                f"variable {v!r} is not on the tape (call .attach_grad() "
+                "inside or before the record scope)")
+        var_entries.append(v._ag_entry)
+
+    replay = _replay_fn(head_entries, var_entries, head_vals)
+    hg_vals = [
+        jnp.ones(h.shape, dtype=h.dtype) if hg is None
+        else (hg._get() if hasattr(hg, "_get") else jnp.asarray(hg))
+        for h, hg in zip(heads, head_grads)]
+
+    def grad_fn(*var_vals):
+        _, vjp = jax.vjp(replay, *var_vals)
+        return vjp(tuple(hg_vals))
+
+    var_vals = [v._get() for v in variables]
+    if is_recording():
+        out_vals, out_entries, _ = record_op(
+            grad_fn, var_vals, var_entries, name="_grad_create_graph")
+    else:
+        out_vals = grad_fn(*var_vals)
+        out_entries = [None] * len(variables)
+    results = []
+    for v, g, e in zip(variables, out_vals, out_entries):
+        nd = _ndm.NDArray._from_jax(g, v.context)
+        nd._ag_entry = e
+        results.append(nd)
+    return results
 
 
 def _zeros_like(x):

@@ -1,0 +1,2 @@
+"""Contrib namespace (reference: python/mxnet/contrib/ — SURVEY.md §3.5)."""
+from . import amp  # noqa: F401

@@ -1,0 +1,13 @@
+"""AMP — automatic mixed precision for TPU (bf16-first).
+
+Reference: python/mxnet/contrib/amp/ (SURVEY.md §3.5 contrib: AMP).
+"""
+from .amp import (init, disable, init_trainer, scale_loss, unscale,
+                  convert_model, convert_hybrid_block, list_fp16_ops,
+                  list_fp32_ops, _cast_scope)
+from .loss_scaler import LossScaler
+from . import lists  # noqa: F401
+
+__all__ = ["init", "disable", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "list_fp16_ops",
+           "list_fp32_ops", "LossScaler"]

@@ -1,0 +1,58 @@
+"""Dynamic loss scaling (reference: python/mxnet/contrib/amp/loss_scaler.py).
+
+Only needed for float16 (5-bit exponent): gradients below ~6e-5 underflow, so
+the loss is multiplied by a large scale before backward and gradients divided
+by it before the update; on overflow (inf/nan grads) the step is skipped and
+the scale halved, and after ``scale_window`` clean steps the scale doubles.
+bfloat16 shares fp32's exponent range, so the TPU-default bf16 policy uses a
+static scale of 1 (this class still tracks overflow-skip behavior).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, min_scale=1.0, dynamic=True):
+        self.loss_scale = float(init_scale) if dynamic else 1.0
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._min_scale = min_scale
+        self._dynamic = dynamic
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient of ``params`` is non-finite."""
+        import jax.numpy as jnp
+
+        for p in params:
+            if p.grad_req == "null" or p._data is None:
+                continue
+            for g in p.list_grad():
+                v = g._get()
+                if not jnp.issubdtype(v.dtype, jnp.floating):
+                    continue
+                if not bool(jnp.isfinite(v).all()):
+                    return True
+        return False
+
+    def update_scale(self, overflow):
+        """Adjust the scale after a step; returns True if the step should be
+        skipped (overflow observed)."""
+        if not self._dynamic:
+            return bool(overflow)
+        if overflow:
+            self.loss_scale = max(self._min_scale,
+                                  self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+            return True
+        self._unskipped += 1
+        if self._unskipped >= self._scale_window:
+            self.loss_scale = float(
+                min(np.finfo(np.float32).max,
+                    self.loss_scale * self._scale_factor))
+            self._unskipped = 0
+        return False

@@ -349,8 +349,11 @@ class HybridBlock(Block):
             return self.hybrid_forward(_F, *args, **params)
 
         in_vals = [a._get() if isinstance(a, NDArray) else a for a in args]
+        from ..ndarray.ndarray import _AMP
+
         key = (tuple((tuple(v.shape), str(v.dtype)) for v in in_vals),
-               _ag.is_training(), _ag.is_recording(), self._cache_version)
+               _ag.is_training(), _ag.is_recording(), self._cache_version,
+               _AMP["target"] if _AMP["on"] else None)
         entry = self._cached_graph.get(key)
         if entry is None:
             entry = self._build_cache(key, all_params, args)
@@ -549,6 +552,7 @@ class SymbolBlock(HybridBlock):
                              for s in inputs]
         arg_names = outputs.list_arguments()
         aux_names = outputs.list_auxiliary_states()
+        self._sym_aux_names = list(aux_names)
         self._sym_param_names = [n for n in arg_names
                                  if n not in self._input_names] + aux_names
         for n in self._sym_param_names:
@@ -595,12 +599,44 @@ class SymbolBlock(HybridBlock):
             pvals.append(self.params.get(n).data())
         names = self._input_names + self._sym_param_names
         training = _ag.is_training()
+        # during training forwards, thread aux-state updates (BatchNorm
+        # moving stats) out of the evaluation and write them back into the
+        # aux parameters — the reference's CachedOp mutates aux states
+        # in-place (ADVICE r1: without this, fine-tuned SymbolBlocks served
+        # stale imported running stats)
+        aux_names = self._sym_aux_names
+        collect = training and bool(aux_names)
+        n_main = {}
         key = NDArray._from_jax(_rnd._next_key(), None)
 
         def pure(key_val, *vals):
-            feed = dict(zip(names, vals))
-            outs, _ = evaluate(heads, feed, rng_key=key_val,
-                               training=training)
-            return tuple(outs) if len(outs) != 1 else outs[0]
+            from jax import lax
 
-        return apply_fn(pure, [key] + list(args) + pvals, name="symbol_block")
+            feed = dict(zip(names, vals))
+            outs, state = evaluate(heads, feed, rng_key=key_val,
+                                   training=training, collect_state=collect)
+            res = list(outs)
+            n_main["n"] = len(res)
+            if collect:
+                res += [lax.stop_gradient(state.get(n, feed[n]))
+                        for n in aux_names]
+            return tuple(res) if len(res) != 1 else res[0]
+
+        out = apply_fn(pure, [key] + list(args) + pvals, name="symbol_block")
+        if not collect:
+            return out
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        n = n_main["n"]
+        main, aux_new = outs[:n], outs[n:]
+        tc = _TRACE.ctx
+        for nme, v in zip(aux_names, aux_new):
+            p = self.params.get(nme)
+            if tc is not None:
+                # under a functionalize/jit trace the update rides out as an
+                # extra jit output (state threading) — writing to .data()
+                # here would only mutate the traced stand-in
+                tc.state_updates.append((p, v._get()))
+            else:
+                with _ag.pause():
+                    p.data()._set(v._get())
+        return main[0] if n == 1 else list(main)

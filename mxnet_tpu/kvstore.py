@@ -279,6 +279,10 @@ def _reduce(vals):
     if all(isinstance(v, RowSparseNDArray) for v in vals):
         # sparse reduce keeps row_sparse storage: only touched rows move
         # (reference: CommCPU rsp reduce / kvstore_dist row_sparse push)
+        if len(vals) == 1:
+            # copy to match the dense path: the stored value must not alias
+            # the caller's gradient array
+            return vals[0].copy()
         acc = vals[0]
         for v in vals[1:]:
             acc = add_rowsparse(acc, v)

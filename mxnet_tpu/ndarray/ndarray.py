@@ -311,6 +311,22 @@ class NDArray:
     def __setitem__(self, key, value):
         jnp = _jnp()
         key = _sanitize_key(key)
+        if _ag.is_recording() and (
+                _on_tape(self) or (isinstance(value, NDArray) and _on_tape(value))):
+            # record the sliced write as a differentiable scatter so gradients
+            # don't silently vanish (reference hard-part 1: in-place writes
+            # are write-var ops on the tape); the handle's tape entry rebinds
+            # to the scatter output
+            if isinstance(value, NDArray):
+                vnd = value
+            else:
+                vnd = NDArray._from_jax(
+                    jnp.asarray(value if isinstance(value, numeric_types)
+                                else _np.asarray(value)), self.context)
+            out = invoke("_scatter_set_key", [self, vnd], {"key": key})
+            self._set(out._get())
+            self._ag_entry = out._ag_entry
+            return
         if isinstance(value, NDArray):
             v = value._get()
         elif isinstance(value, numeric_types):
@@ -645,6 +661,11 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
         out_ctx = current_context()
 
     fn = functools.partial(_call_with_attrs, od.fn, attrs)
+    if _AMP["on"]:
+        # mixed-precision cast policy (contrib.amp): wraps fn so per-op input
+        # casts are part of the traced/vjp'd computation — gradients flow back
+        # to the original (fp32 master) dtype through the cast's transpose
+        fn = _AMP["wrap"](od, fn)
 
     recording = (_ag.is_recording() and od.differentiable
                  and any(isinstance(a, NDArray) and _on_tape(a) for a in nd_args if a is not None))
@@ -678,6 +699,11 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
 # flag flipped by symbol-export tracing (symbol/symbol.py trace_invoke) so the
 # hot imperative path pays one dict lookup, not an isinstance sweep
 _SYMTRACE = {"on": False}
+
+# mixed-precision state, owned by contrib.amp (reference: amp.init()
+# monkey-patches op namespaces — here one dict lookup gates the hot path).
+# "wrap": callable(opdef, fn) -> fn installed by contrib.amp.
+_AMP = {"on": False, "wrap": None}
 
 
 def _call_with_attrs(fn, attrs, *arrays):

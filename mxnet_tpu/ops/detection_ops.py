@@ -265,7 +265,7 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
     aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-12)
     ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-12)
 
-    def one_sample(lbl):
+    def one_sample(lbl, pred):
         gt_valid = lbl[:, 0] >= 0                       # (M,)
         iou = _pairwise_iou(anchors, lbl[:, 1:5], "corner")   # (A,M)
         iou = jnp.where(gt_valid[None, :], iou, -1.0)
@@ -296,11 +296,30 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         th = jnp.log(gh / ah) / variances[3]
         box_t = jnp.stack([tx, ty, tw, th], axis=-1)    # (A,4)
         mask = matched[:, None].astype(box_t.dtype)
-        cls_t = jnp.where(matched, g[:, 0] + 1, 0.0)    # 0 = background
+        if negative_mining_ratio > 0:
+            # hard negative mining (multibox_target.cc semantics): unmatched
+            # anchors below the mining IoU threshold compete by their max
+            # non-background confidence; the top num_matched*ratio stay
+            # background, the rest (and high-IoU unmatched) become
+            # ignore_label so SSD doesn't drown in easy negatives
+            neg_cand = (~matched) & (best_iou < negative_mining_thresh)
+            conf = jnp.max(pred[1:, :], axis=0)          # (A,)
+            k = jnp.maximum(
+                matched.sum().astype(jnp.float32) * negative_mining_ratio,
+                float(minimum_negative_samples))
+            score = jnp.where(neg_cand, conf, -jnp.inf)
+            order = jnp.argsort(-score)
+            rank = jnp.zeros(na, dtype=jnp.int32).at[order].set(
+                jnp.arange(na, dtype=jnp.int32))
+            keep_neg = neg_cand & (rank.astype(jnp.float32) < k)
+            cls_t = jnp.where(matched, g[:, 0] + 1,
+                              jnp.where(keep_neg, 0.0, float(ignore_label)))
+        else:
+            cls_t = jnp.where(matched, g[:, 0] + 1, 0.0)  # 0 = background
         return (box_t * mask).reshape(-1), \
             jnp.broadcast_to(mask, box_t.shape).reshape(-1), cls_t
 
-    bt, bm, ct = jax.vmap(one_sample)(label)
+    bt, bm, ct = jax.vmap(one_sample)(label, cls_pred)
     return bt, bm, ct
 
 

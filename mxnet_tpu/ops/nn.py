@@ -296,16 +296,25 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
     axes = tuple(i for i in range(x.ndim) if i != axis)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
+    # mixed-precision contract (reference: BN runs multi-precision under AMP):
+    # statistics accumulate in fp32 even for bf16/fp16 activations (XLA's
+    # reduction accumulator is fp32 once the operand is upcast per-element
+    # inside the fused reduce); the normalize itself stays in the activation
+    # dtype so the residuals saved for backward don't double HBM traffic.
+    in_dtype = x.dtype
+    f32 = jnp.float32
     if training and not use_global_stats:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
-        new_mean = moving_mean * momentum + mean * (1 - momentum)
-        new_var = moving_var * momentum + var * (1 - momentum)
+        mean = jnp.mean(x.astype(f32), axis=axes)
+        var = jnp.var(x.astype(f32), axis=axes)
+        new_mean = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
+        new_var = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
     else:
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
-    xh = (x - mean.reshape(shape)) * _lax().rsqrt(var.reshape(shape) + eps)
-    out = xh * g.reshape(shape) + beta.reshape(shape)
+    scale = (g.astype(f32) * _lax().rsqrt(var.astype(f32) + eps))
+    bias = beta.astype(f32) - mean.astype(f32) * scale
+    out = (x * scale.reshape(shape).astype(in_dtype)
+           + bias.reshape(shape).astype(in_dtype))
     from jax import lax as _l
 
     return out, _l.stop_gradient(new_mean), _l.stop_gradient(new_var)
@@ -314,12 +323,14 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
 @register("LayerNorm", aliases=("layer_norm",))
 def layer_norm(x, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     jnp = _jnp()
-    mean = jnp.mean(x, axis=axis, keepdims=True)
-    var = jnp.var(x, axis=axis, keepdims=True)
-    xh = (x - mean) * _lax().rsqrt(var + eps)
+    in_dtype = x.dtype
+    xf = x.astype(jnp.float32) if in_dtype != jnp.float32 else x
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.var(xf, axis=axis, keepdims=True)
+    xh = (xf - mean) * _lax().rsqrt(var + eps)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    return xh * gamma.reshape(shape) + beta.reshape(shape)
+    return (xh * gamma.reshape(shape) + beta.reshape(shape)).astype(in_dtype)
 
 
 @register("GroupNorm", aliases=("group_norm",))

@@ -402,6 +402,16 @@ def _slice_key(x, key=None):
     return x[key]
 
 
+@register("_scatter_set_key")
+def _scatter_set_key(x, v, key=None):
+    """Internal: differentiable sliced write (NDArray.__setitem__ under
+    autograd recording — SURVEY.md hard-part 1: the reference records
+    in-place writes as write-var engine ops; here the functional update's
+    vjp routes cotangents to the untouched region of ``x`` and the written
+    ``v``)."""
+    return x.at[key].set(v.astype(x.dtype))
+
+
 @register("slice_axis")
 def slice_axis(x, axis=0, begin=0, end=None):
     jnp = _jnp()

@@ -128,7 +128,8 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, param_sharding="replicated", extra_param_specs=None,
-                 batch_axes=("dp", "fsdp"), donate=True, train_mode=True):
+                 batch_axes=("dp", "fsdp"), donate=True, train_mode=True,
+                 dtype=None):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -204,16 +205,32 @@ class TrainStep:
                 if leaf.ndim == 0 else leaf, self.opt_state)
 
         with_state = self._with_state
+        # mixed precision (AMP): trace the model under the bf16/fp16 cast
+        # policy — master weights stay fp32, matmuls/convs run low-precision
+        # on the MXU, loss is computed in fp32 (contrib.amp._cast_scope)
+        if dtype is None:
+            from contextlib import nullcontext
+
+            amp_scope = nullcontext
+        else:
+            from ..contrib.amp import _cast_scope
+
+            amp_scope = partial(_cast_scope, dtype)
 
         def step(train_params, rest_params, opt_state, rng, x, y):
             def loss_of(tp):
                 p = dict(rest_params)
                 p.update(tp)
-                if with_state:
-                    out, state = apply_fn(p, rng, x)
-                else:
-                    out = apply_fn(p, rng, x)
-                    state = {}
+                with amp_scope():
+                    if with_state:
+                        out, state = apply_fn(p, rng, x)
+                    else:
+                        out = apply_fn(p, rng, x)
+                        state = {}
+                if dtype is not None:
+                    out = jax.tree_util.tree_map(
+                        lambda o: o.astype(jnp.float32)
+                        if jnp.issubdtype(o.dtype, jnp.floating) else o, out)
                 return jnp.mean(loss_fn(out, y)), state
 
             (loss, state), grads = jax.value_and_grad(

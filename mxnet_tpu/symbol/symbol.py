@@ -733,7 +733,16 @@ def evaluate(heads, feed, rng_key=None, training=False, collect_state=False):
             attrs["training"] = True
         if od.needs_rng:
             in_vals = [next_key()] + in_vals
-        out = od.fn(*in_vals, **attrs)
+        from ..ndarray.ndarray import _AMP
+
+        if _AMP["on"]:
+            # same mixed-precision cast policy as the imperative invoke path
+            # (contrib.amp): without this, SymbolBlock/Executor graphs would
+            # silently run full-precision under amp.init()/TrainStep(dtype=…)
+            fn = _AMP["wrap"](od, lambda *a, _f=od.fn, _at=attrs: _f(*a, **_at))
+            out = fn(*in_vals)
+        else:
+            out = od.fn(*in_vals, **attrs)
         outs = out if isinstance(out, (tuple, list)) else (out,)
         for i, v in enumerate(outs):
             vals[(id(n), i)] = v
