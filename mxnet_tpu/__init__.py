@@ -62,5 +62,6 @@ from . import runtime
 from . import callback
 from . import monitor
 from . import parallel
+from . import contrib
 
 from .ndarray import NDArray

@@ -58,10 +58,15 @@ class Context:
     # -- jax resolution ----------------------------------------------------
     @property
     def device(self):
-        """Concrete jax.Device this context resolves to."""
+        """Concrete jax.Device this context resolves to.
+
+        In a multi-process (jax.distributed) job, contexts index the
+        *process-local* devices — the reference's ctx numbering is likewise
+        per-worker (each ps-lite worker sees only its own GPUs)."""
         jax = _jax()
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu")
+            devs = [d for d in jax.local_devices() if d.platform == "cpu"] \
+                or jax.devices("cpu")
         else:  # tpu / gpu -> accelerator backend
             devs = _accelerator_devices()
             if not devs:
@@ -109,7 +114,11 @@ class Context:
 
 
 def _accelerator_devices():
-    """All non-cpu jax devices (tpu, or the axon tunnel platform)."""
+    """Process-local non-cpu jax devices (tpu, or the axon tunnel platform)."""
+    jax = _jax()
+    local = [d for d in jax.local_devices() if d.platform != "cpu"]
+    if local:
+        return local
     jax = _jax()
     return [d for d in jax.devices() if d.platform != "cpu"]
 

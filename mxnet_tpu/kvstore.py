@@ -208,10 +208,34 @@ class DistTPUSyncKVStore(KVStore):
             self._mesh = _mesh.get_default_mesh()
         return self._mesh
 
+    def set_optimizer(self, optimizer):
+        """update_on_kvstore distributed semantics (SURVEY.md §6.8): the
+        server-side optimizer becomes a reduce-scatter + sharded-state update
+        + all-gather over the device mesh.  Optimizers without a jax-pure
+        sharded implementation fall back to the replicated local updater
+        (numerically identical, state not sharded)."""
+        from .parallel import distributed as _dist
+
+        super().set_optimizer(optimizer)
+        if _dist.supports_sharded_update(self._optimizer):
+            self._updater = _dist.ShardedOptimizerUpdater(self._optimizer)
+            self._sharded_update = True
+        else:
+            self._sharded_update = False
+
     def push(self, key, value, priority=0):
         keys, grouped = _group_key_value(key, value)
         for k, vals in zip(keys, grouped):
             reduced = _reduce(vals)
+            if getattr(self, "_sharded_update", False) and \
+                    self._updater is not None:
+                # the sharded updater consumes the process-local reduced
+                # gradient directly: the cross-process sum happens inside
+                # its jit as the reduce-scatter input
+                if self._compression is not None:
+                    reduced = self._compression.round_trip(reduced, key=k)
+                self._updater(_key_int(k), reduced, self._store[k])
+                continue
             if self.num_workers > 1:
                 reduced = self._allreduce(reduced)
             if self._compression is not None:

@@ -56,6 +56,8 @@ def get_lib():
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64)]
+        lib.mxtpu_reader_read_errors.restype = ctypes.c_int64
+        lib.mxtpu_reader_read_errors.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -77,8 +79,17 @@ class NativeRecordReader:
     def num_records(self):
         return self._lib.mxtpu_reader_num_records(self._handle)
 
+    @property
+    def read_errors(self):
+        """Count of records dropped due to truncated/unreadable file data."""
+        return self._lib.mxtpu_reader_read_errors(self._handle)
+
     def reset(self):
         self._epoch += 1
+        if self.read_errors:
+            raise MXNetError(
+                f"{self.read_errors} record(s) could not be read (truncated "
+                "or corrupt record file)")
         self._lib.mxtpu_reader_reset(self._handle, self._epoch)
 
     def next_batch(self):

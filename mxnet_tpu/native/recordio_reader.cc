@@ -11,6 +11,7 @@
 // IO overlap is what matters for feeding a chip.
 //
 // Exposed as a C ABI for ctypes (the reference's C API pattern, §3.1).
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -70,6 +71,9 @@ class Reader {
   }
 
   int64_t num_records() const { return static_cast<int64_t>(shard_.size()); }
+  int64_t read_errors() const {
+    return read_errors_.load(std::memory_order_relaxed);
+  }
 
   bool open_ok() const { return open_ok_; }
 
@@ -181,8 +185,15 @@ class Reader {
       for (const auto& r : refs) {
         size_t old = b.data.size();
         b.data.resize(old + r.length);
-        if (std::fseek(f, static_cast<long>(r.offset), SEEK_SET) != 0) break;
-        if (std::fread(b.data.data() + old, 1, r.length, f) != r.length) break;
+        if (std::fseek(f, static_cast<long>(r.offset), SEEK_SET) != 0 ||
+            std::fread(b.data.data() + old, 1, r.length, f) != r.length) {
+          // truncated/unreadable record: drop the partial bytes so the
+          // batch stays self-consistent, and count the error so the Python
+          // side can surface it instead of silently losing records
+          b.data.resize(old);
+          read_errors_.fetch_add(1, std::memory_order_relaxed);
+          break;
+        }
         b.lengths.push_back(r.length);
       }
       {
@@ -208,6 +219,8 @@ class Reader {
   std::vector<RecordRef> shard_;
   std::vector<size_t> order_;
   size_t cursor_ = 0;
+
+  std::atomic<int64_t> read_errors_{0};
 
   std::mutex mu_;
   std::condition_variable cv_data_, cv_space_;
@@ -251,6 +264,10 @@ int mxtpu_reader_next_batch(void* handle, const uint8_t** data,
                             uint64_t* total_bytes) {
   return static_cast<Reader*>(handle)->NextBatch(data, lengths, n_records,
                                                  total_bytes);
+}
+
+int64_t mxtpu_reader_read_errors(void* handle) {
+  return static_cast<Reader*>(handle)->read_errors();
 }
 
 }  // extern "C"

@@ -15,9 +15,10 @@ north star requires (TP/FSDP/SP that MXNet 1.x never had):
 """
 from . import mesh
 from . import collectives
+from . import distributed
 from .mesh import make_mesh, get_default_mesh, set_default_mesh
 from .context_parallel import ring_attention, context_parallel_attention
 
-__all__ = ["mesh", "collectives", "make_mesh", "get_default_mesh",
-           "set_default_mesh", "ring_attention",
+__all__ = ["mesh", "collectives", "distributed", "make_mesh",
+           "get_default_mesh", "set_default_mesh", "ring_attention",
            "context_parallel_attention"]

@@ -1,0 +1,276 @@
+"""Multi-process bootstrap + sharded-optimizer update.
+
+Reference mapping (SURVEY.md §3.3, §4.4): replaces the ps-lite
+worker/server/scheduler triangle.
+
+- ``init()`` ≙ ``Postoffice::Start`` rendezvous: reads the same env contract
+  the reference launcher sets (``DMLC_PS_ROOT_URI/PORT``, ``DMLC_NUM_WORKER``,
+  ``DMLC_WORKER_ID``) and calls ``jax.distributed.initialize`` so every
+  process sees the global device mesh.
+- ``ShardedOptimizerUpdater`` ≙ the server-side optimizer
+  (``KVStoreDistServer::ApplyUpdates`` + key-range sharding): gradients are
+  reduce-scattered over the mesh, each shard of the optimizer state lives on
+  one device, and the updated weight is all-gathered — the "Automatic
+  Cross-Replica Sharding of Weight Update" recipe (PAPERS.md), expressed as
+  sharding annotations that GSPMD lowers to reduce-scatter + all-gather on
+  ICI/DCN.
+"""
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["init", "is_initialized", "ShardedOptimizerUpdater"]
+
+_STATE = {"initialized": False}
+
+
+def init(coordinator_address=None, num_processes=None, process_id=None,
+         local_device_ids=None):
+    """Initialize jax.distributed from args or the launcher env contract.
+
+    Env fallbacks (reference: ps-lite bootstrap, tools/launch.py):
+      MXNET_COORDINATOR_ADDRESS  or  DMLC_PS_ROOT_URI + DMLC_PS_ROOT_PORT
+      MXNET_NUM_WORKERS          or  DMLC_NUM_WORKER
+      MXNET_WORKER_ID            or  DMLC_WORKER_ID
+
+    No-op (returns False) when the env describes a single-process job.
+    """
+    import jax
+
+    if _STATE["initialized"]:
+        return True
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MXNET_COORDINATOR_ADDRESS")
+        if coordinator_address is None:
+            uri = os.environ.get("DMLC_PS_ROOT_URI")
+            port = os.environ.get("DMLC_PS_ROOT_PORT")
+            if uri and port:
+                coordinator_address = f"{uri}:{port}"
+    if num_processes is None:
+        num_processes = int(os.environ.get(
+            "MXNET_NUM_WORKERS", os.environ.get("DMLC_NUM_WORKER", "1")))
+    if process_id is None:
+        process_id = int(os.environ.get(
+            "MXNET_WORKER_ID", os.environ.get("DMLC_WORKER_ID", "0")))
+    if num_processes <= 1 or coordinator_address is None:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id,
+                               local_device_ids=local_device_ids)
+    _STATE["initialized"] = True
+    return True
+
+
+def is_initialized():
+    return _STATE["initialized"]
+
+
+# --------------------------------------------------------------------------
+# sharded optimizer update (update_on_kvstore distributed semantics)
+# --------------------------------------------------------------------------
+_SUPPORTED = {"SGD": "sgd", "Adam": "adam"}
+
+
+def supports_sharded_update(optimizer):
+    return type(optimizer).__name__ in _SUPPORTED
+
+
+class ShardedOptimizerUpdater:
+    """Per-key reduce-scatter + sharded optimizer state + all-gather.
+
+    The weight stays replicated on every process; the optimizer state
+    (momentum / Adam moments) for each key is a flat padded array sharded
+    over the full device mesh — each device owns exactly its shard of the
+    update, which is what the reference's key-range-sharded servers do.
+    """
+
+    def __init__(self, optimizer):
+        kind = _SUPPORTED.get(type(optimizer).__name__)
+        if kind is None:
+            raise MXNetError(
+                f"sharded update unsupported for {type(optimizer).__name__}")
+        self.optimizer = optimizer
+        self._kind = kind
+        self._state = {}   # key -> dict of flat sharded arrays
+        self._jits = {}    # (shape, dtype) -> compiled step
+        self._mesh = None
+
+    # -- mesh / sharding helpers -------------------------------------------
+    def _get_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        if self._mesh is None:
+            self._mesh = Mesh(_np.array(jax.devices()), ("w",))
+        return self._mesh
+
+    def _flat_spec(self, size):
+        import jax
+
+        n = len(jax.devices())
+        pad = (-size) % n
+        return pad
+
+    # -- jit step ----------------------------------------------------------
+    def _make_step(self, shape, dtype):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._get_mesh()
+        n_local = jax.local_device_count()
+        size = int(_np.prod(shape)) if shape else 1
+        pad = self._flat_spec(size)
+        shard = NamedSharding(mesh, P("w"))
+        repl = NamedSharding(mesh, P())
+        kind = self._kind
+
+        def to_shard(x):
+            xf = jnp.pad(x.reshape(-1), (0, pad))
+            return lax.with_sharding_constraint(xf, shard)
+
+        if kind == "sgd":
+            def step(w, gstack, mom, lr, wd, mu, rescale):
+                # sum over the per-device contributions: feeding a sharded
+                # consumer, GSPMD lowers this to a reduce-scatter
+                g = gstack.sum(axis=0) * (1.0 / n_local) * rescale
+                gf = to_shard(g)
+                wf = to_shard(w)
+                gf = gf + wd * wf
+                mom_new = mu * mom + gf
+                wf_new = wf - lr * mom_new
+                w_new = wf_new[:size].reshape(shape)  # replicated out ⇒ all-gather
+                return w_new, (mom_new,)
+
+            n_state = 1
+        else:  # adam
+            def step(w, gstack, m, v, t, lr, wd, b1, b2, eps, rescale):
+                g = gstack.sum(axis=0) * (1.0 / n_local) * rescale
+                gf = to_shard(g)
+                wf = to_shard(w)
+                gf = gf + wd * wf
+                t_new = t + 1
+                m_new = b1 * m + (1 - b1) * gf
+                v_new = b2 * v + (1 - b2) * gf * gf
+                c1 = 1 - b1 ** t_new.astype(jnp.float32)
+                c2 = 1 - b2 ** t_new.astype(jnp.float32)
+                wf_new = wf - lr * (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+                w_new = wf_new[:size].reshape(shape)
+                return w_new, (m_new, v_new, t_new)
+
+            n_state = 2  # t handled separately (scalar)
+
+        out_state_shardings = (shard,) * n_state
+        if kind == "adam":
+            out_state_shardings = (shard, shard, repl)
+        jitted = jax.jit(step, out_shardings=(repl, out_state_shardings))
+        return jitted, pad, size
+
+    def _init_state(self, key, shape, dtype):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._get_mesh()
+        size = int(_np.prod(shape)) if shape else 1
+        pad = self._flat_spec(size)
+        shard = NamedSharding(mesh, P("w"))
+        zeros = jax.device_put(jnp.zeros(size + pad, dtype), shard)
+        if self._kind == "sgd":
+            return (zeros,)
+        t0 = jax.device_put(jnp.zeros((), "int32"),
+                            NamedSharding(mesh, P()))
+        return (zeros, jax.device_put(jnp.zeros(size + pad, dtype), shard), t0)
+
+    def _stack_contributions(self, g):
+        """Build the global (num_global_devices, ...) contribution array:
+        every local device carries this process's reduced gradient; the jit
+        divides by local_device_count so the global sum equals the
+        cross-process sum."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._get_mesh()
+        n_local = jax.local_device_count()
+        local = jnp.broadcast_to(g[None], (n_local,) + g.shape)
+        if jax.process_count() == 1:
+            return jax.device_put(local, NamedSharding(mesh, P("w")))
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("w")), _np.asarray(local))
+
+    # -- the updater interface (matches opt_mod.get_updater's calling seam) --
+    def __call__(self, index, grad_nd, weight_nd):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        opt = self.optimizer
+        key = index
+        # replicate the weight over the mesh (it arrives committed to one
+        # device; the jit output is replicated so steady-state is a no-op)
+        w = jax.device_put(weight_nd._get(),
+                           NamedSharding(self._get_mesh(), P()))
+        g = grad_nd._get()
+        shape, dtype = tuple(w.shape), w.dtype
+        sig = (key, shape, str(dtype))
+        if sig not in self._jits:
+            self._jits[sig] = self._make_step(shape, dtype)
+        jitted, pad, size = self._jits[sig]
+        if key not in self._state:
+            self._state[key] = self._init_state(key, shape, dtype)
+        opt._update_count(index)
+        lr = opt._get_lr(index)
+        wd = opt._get_wd(index)
+        rescale = opt.rescale_grad
+        gstack = self._stack_contributions(g)
+        if self._kind == "sgd":
+            (mom,) = self._state[key]
+            w_new, (mom_new,) = jitted(w, gstack, mom, lr, wd,
+                                       getattr(opt, "momentum", 0.0), rescale)
+            self._state[key] = (mom_new,)
+        else:
+            m, v, t = self._state[key]
+            w_new, (m2, v2, t2) = jitted(w, gstack, m, v, t, lr, wd,
+                                         opt.beta1, opt.beta2, opt.epsilon,
+                                         rescale)
+            self._state[key] = (m2, v2, t2)
+        weight_nd._set(w_new)
+
+    # -- state io (Trainer.save_states compatibility) ----------------------
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        host = {k: tuple(_np.asarray(s) for s in v)
+                for k, v in self._state.items()}
+        payload = {"state": host, "kind": self._kind}
+        if dump_optimizer:
+            payload["optimizer"] = self.optimizer
+        return pickle.dumps(payload)
+
+    def set_states(self, blob):
+        import pickle
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        payload = pickle.loads(blob)
+        mesh = self._get_mesh()
+        shard = NamedSharding(mesh, P("w"))
+        restored = {}
+        for k, states in payload["state"].items():
+            rs = []
+            for s in states:
+                arr = jnp.asarray(s)
+                rs.append(jax.device_put(
+                    arr, shard if arr.ndim else NamedSharding(mesh, P())))
+            restored[k] = tuple(rs)
+        self._state = restored
+        if "optimizer" in payload:
+            self.optimizer = payload["optimizer"]

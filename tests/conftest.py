@@ -4,25 +4,51 @@ Mirrors the reference's "distributed without a cluster" strategy (SURVEY.md
 §5.4: launcher-local multi-process PS tests) using XLA's host-platform
 device-count flag, so KVStore/mesh/sharding tests exercise real collectives
 on 8 virtual devices with no TPU pod.
+
+TPU lane (reference: tests/python/gpu/ — the CPU-vs-GPU consistency oracle,
+SURVEY.md §5.2): ``MXNET_TEST_TPU=1 pytest -m tpu`` keeps the real chip as
+the default platform and runs the ``tpu``-marked tests (they self-skip when
+no TPU is present).
 """
 import os
 
-# must run before jax initializes
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+_TPU_LANE = os.environ.get("MXNET_TEST_TPU", "") == "1"
+
+if not _TPU_LANE:
+    # must run before jax initializes
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-# the axon TPU-tunnel sitecustomize force-selects its platform via
-# jax.config; override back to CPU so the suite runs on the 8 virtual
-# devices (the env var alone is not enough once the plugin registered).
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_LANE:
+    # the axon TPU-tunnel sitecustomize force-selects its platform via
+    # jax.config; override back to CPU so the suite runs on the 8 virtual
+    # devices (the env var alone is not enough once the plugin registered).
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: needs the real TPU chip (MXNET_TEST_TPU=1 lane)")
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running integration test")
+
+
+def pytest_collection_modifyitems(config, items):
+    if _TPU_LANE:
+        return
+    skip_tpu = pytest.mark.skip(
+        reason="TPU lane disabled (set MXNET_TEST_TPU=1 and run on hardware)")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip_tpu)
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,63 @@
+"""Worker script for the 2-process dist_tpu_sync test (reference:
+tests/nightly/dist_sync_kvstore.py, invoked via tools/launch.py -n 2
+--launcher local — SURVEY.md §5.4 'distributed without a cluster')."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu.parallel import distributed
+
+assert distributed.init(), "distributed.init must bootstrap from launcher env"
+
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_tpu_sync")
+rank, n = kv.rank, kv.num_workers
+assert n == 2, f"expected 2 workers, got {n}"
+
+# 1. push/pull: cross-process gradient sum (KVStoreDist sync semantics)
+kv.init(3, mx.nd.zeros((4, 5)))
+kv.push(3, mx.nd.ones((4, 5)) * (rank + 1))
+out = mx.nd.zeros((4, 5))
+kv.pull(3, out)
+expect = float(sum(r + 1 for r in range(n)))
+np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+# 2. update_on_kvstore: sharded optimizer (reduce-scatter + all-gather)
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.0))
+w0 = np.arange(12, dtype="f").reshape(3, 4) / 10.0
+kv.init(7, mx.nd.array(w0))
+g_local = np.full((3, 4), rank + 1.0, dtype="f")
+kv.push(7, mx.nd.array(g_local))
+w1 = mx.nd.zeros((3, 4))
+kv.pull(7, w1)
+g_sum = np.full((3, 4), 3.0, dtype="f")   # 1 + 2 across the two workers
+mom = g_sum
+expect_w1 = w0 - 0.1 * mom
+np.testing.assert_allclose(w1.asnumpy(), expect_w1, rtol=1e-5)
+
+# second step exercises the sharded momentum state
+kv.push(7, mx.nd.array(g_local))
+w2 = mx.nd.zeros((3, 4))
+kv.pull(7, w2)
+mom = 0.9 * mom + g_sum
+expect_w2 = expect_w1 - 0.1 * mom
+np.testing.assert_allclose(w2.asnumpy(), expect_w2, rtol=1e-5)
+
+# 3. row_sparse_pull across processes
+rows = mx.nd.array(np.array([0, 2], "f"))
+rout = mx.nd.zeros((2, 4))
+kv.row_sparse_pull(7, out=rout, row_ids=rows)
+np.testing.assert_allclose(rout.asnumpy(), expect_w2[[0, 2]], rtol=1e-5)
+
+marker = os.environ.get("DIST_TEST_MARKER")
+if marker:
+    with open(f"{marker}.{rank}", "w") as f:
+        f.write("ok")
+print(f"worker {rank}: all dist assertions passed", flush=True)

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Multi-process job launcher (reference: tools/launch.py + the dmlc-core
+local tracker — SURVEY.md §3.3 "Launcher": spawns the process group and sets
+the bootstrap env contract each process reads).
+
+TPU-native shape: there are no separate server/scheduler roles — every
+process is an SPMD worker that calls ``mxnet_tpu.parallel.distributed.init()``
+(≙ Postoffice::Start), which reads the env this launcher sets:
+
+    MXNET_COORDINATOR_ADDRESS   host:port of process 0 (jax.distributed)
+    MXNET_NUM_WORKERS           process count
+    MXNET_WORKER_ID             this process's id
+
+The reference's ``DMLC_*`` names are also set for script compatibility.
+
+Usage (mirrors the reference CLI)::
+
+    python tools/launch.py -n 4 [--launcher local] [--env K=V ...] \
+        python train.py --your-args
+
+``--launcher local`` (default) runs all workers on this machine — exactly
+how the reference CI ran its dist kvstore tests without a cluster
+(integrationtest_ubuntu_cpu_dist_kvstore).  ``ssh``/``mpi`` launchers are
+out of scope for a single-pod TPU job: multi-host pods are provisioned by
+the TPU runtime which starts one process per host with the coordinator env
+already present.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference CLI compatibility; the TPU "
+                         "build has no server role (ignored)")
+    ap.add_argument("--launcher", default="local", choices=["local"],
+                    help="process launcher (local = this machine)")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra K=V env entries for every worker")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="coordinator port (0 = pick a free one)")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="the worker command")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("missing worker command")
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+
+    port = args.port or _free_port()
+    coord = f"{args.host}:{port}"
+    procs = []
+    try:
+        for wid in range(args.num_workers):
+            env = dict(os.environ)
+            env.update({
+                "MXNET_COORDINATOR_ADDRESS": coord,
+                "MXNET_NUM_WORKERS": str(args.num_workers),
+                "MXNET_WORKER_ID": str(wid),
+                # reference env contract (§4.4 bootstrap)
+                "DMLC_PS_ROOT_URI": args.host,
+                "DMLC_PS_ROOT_PORT": str(port),
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_WORKER_ID": str(wid),
+                "DMLC_ROLE": "worker",
+            })
+            for kv in args.env:
+                k, _, v = kv.partition("=")
+                env[k] = v
+            procs.append(subprocess.Popen(cmd, env=env))
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
