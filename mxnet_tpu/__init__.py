@@ -31,6 +31,10 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 from .base import MXNetError
+from . import engine
+
+engine._init_from_env()
+
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
 from . import context
 from . import base

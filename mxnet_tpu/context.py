@@ -119,7 +119,6 @@ def _accelerator_devices():
     local = [d for d in jax.local_devices() if d.platform != "cpu"]
     if local:
         return local
-    jax = _jax()
     return [d for d in jax.devices() if d.platform != "cpu"]
 
 

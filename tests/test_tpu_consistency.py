@@ -7,9 +7,10 @@ pair is (jax CPU backend, real TPU chip); run with::
 
     MXNET_TEST_TPU=1 python -m pytest -m tpu tests/ -q
 
-TPU fp32 matmuls/convs use bf16 MXU passes at default precision, so the
-matmul tolerance is looser than the elementwise one — same ladder shape as
-the reference's fp16 rows.
+fp32 matmuls/convs run at precision=HIGHEST by default (mxnet_tpu.engine
+policy: fp32 means fp32, bf16 is explicit via AMP), so the matmul ladder
+only absorbs accumulation-order differences; the transcendental ladder
+matches the reference's fp32 row (see TRANSCENDENTAL_TOL below).
 """
 import numpy as np
 import pytest
@@ -31,12 +32,25 @@ _R = np.random.RandomState(0)
 
 # (opname, input builders, attrs, rtol)
 ELEMWISE_TOL = 1e-5
-MATMUL_TOL = 2e-2  # fp32-via-MXU ladder
+# TPU computes transcendentals in hardware approximation units whose results
+# legitimately differ from CPU libm by ~1e-4 abs / a few e-3 rel near their
+# zeros (measured: tanh 4e-5, log 1e-4, gammaln 1e-4 abs).  The reference's
+# own fp32 check_consistency ladder is 1e-3 (tests/python/gpu/
+# test_operator_gpu.py default tol[np.dtype(np.float32)] = 1e-3), so the
+# transcendental family uses that ladder rather than the elementwise one.
+TRANSCENDENTAL_TOL = 1e-3
+# fp32 matmuls run precision=HIGHEST by default (mxnet_tpu.engine policy:
+# fp32 means fp32; bf16 is explicit via AMP) so the MXU ladder only needs to
+# absorb fp32 accumulation-order differences, not bf16 passes.
+MATMUL_TOL = 2e-2
 
 _UNARY = ["sigmoid", "tanh", "exp", "log", "sqrt", "square", "abs",
           "relu", "softsign", "erf", "rsqrt", "cbrt", "log1p", "expm1",
           "sin", "cos", "arctan", "floor", "ceil", "round", "sign",
           "gamma", "gammaln", "reciprocal"]
+_TRANSCENDENTAL = {"tanh", "exp", "log", "log1p", "expm1", "sin", "cos",
+                   "arctan", "erf", "gamma", "gammaln", "rsqrt", "cbrt",
+                   "sigmoid"}
 _BINARY = ["elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
            "broadcast_add", "broadcast_sub", "broadcast_mul",
            "broadcast_div", "broadcast_maximum", "broadcast_minimum",
@@ -67,7 +81,11 @@ def check_consistency(op, arrays, attrs=None, rtol=ELEMWISE_TOL,
 @pytest.mark.parametrize("op", _UNARY)
 def test_unary_consistency(op):
     x = _R.uniform(0.1, 2.0, (4, 37)).astype("float32")
-    check_consistency(op, [x])
+    if op in _TRANSCENDENTAL:
+        check_consistency(op, [x], rtol=TRANSCENDENTAL_TOL,
+                          atol=TRANSCENDENTAL_TOL)
+    else:
+        check_consistency(op, [x])
 
 
 @requires_tpu
