@@ -172,7 +172,10 @@ def unscale(trainer):
     trainer._amp_unscaled = True
 
 
-def convert_model(block, target_dtype=_DEFAULT_TARGET, excluded_params=("gamma", "beta", "moving_mean", "moving_var")):
+def convert_model(block, target_dtype=_DEFAULT_TARGET,
+                  excluded_params=("gamma", "beta", "moving_mean",
+                                   "moving_var", "running_mean",
+                                   "running_var")):
     """Cast a trained block's parameters to the target dtype for inference
     (reference: amp.convert_model).  Norm-layer params stay fp32."""
     import jax.numpy as jnp

@@ -25,9 +25,14 @@ class LossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params):
-        """True if any gradient of ``params`` is non-finite."""
+        """True if any gradient of ``params`` is non-finite.
+
+        In a multi-process job the verdict is agreed across all processes
+        (logical-or via a host allreduce): a process-local skip would desync
+        the replicas' weights and loss scales."""
         import jax.numpy as jnp
 
+        overflow = False
         for p in params:
             if p.grad_req == "null" or p._data is None:
                 continue
@@ -36,8 +41,18 @@ class LossScaler:
                 if not jnp.issubdtype(v.dtype, jnp.floating):
                     continue
                 if not bool(jnp.isfinite(v).all()):
-                    return True
-        return False
+                    overflow = True
+                    break
+            if overflow:
+                break
+        import jax
+
+        if jax.process_count() > 1:
+            from ...parallel.collectives import allreduce_hosts
+
+            overflow = bool(np.asarray(
+                allreduce_hosts(jnp.asarray(overflow, jnp.float32))) > 0)
+        return overflow
 
     def update_scale(self, overflow):
         """Adjust the scale after a step; returns True if the step should be

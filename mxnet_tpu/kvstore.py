@@ -104,9 +104,24 @@ class KVStore:
             return self.pull(key, out, priority)
         outs = _as_list(out)
         rids = _as_list(row_ids)
-        src = self._store[key if not isinstance(key, (list, tuple)) else key[0]]
-        for o, r in zip(outs, rids):
-            rows = invoke("take", [src, r], {"axis": 0, "mode": "clip"})
+        keys = _as_list(key)
+        if len(keys) == 1 and len(outs) > 1:
+            keys = keys * len(outs)
+        for k, o, r in zip(keys, outs, rids):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            src = self._store[k]
+            src_val = src._get()
+            sharding = getattr(src_val, "sharding", None)
+            if sharding is not None and len(sharding.device_set) > 1:
+                # after a sharded update the stored weight is a global array
+                # over the whole mesh (multi-process or multi-device); it
+                # cannot mix with the single-device row_ids inside one
+                # computation, so read the local replica out first
+                src = NDArray._from_jax(_np.asarray(src_val), src.context)
+            src_local = src.as_in_context(o.context)
+            rows = invoke("take", [src_local, r], {"axis": 0, "mode": "clip"})
             o._set(rows._get().astype(o._get().dtype))
 
     # -- optimizer attach ---------------------------------------------------

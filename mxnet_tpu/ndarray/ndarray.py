@@ -54,13 +54,10 @@ def waitall():
 
     Reference: mx.nd.waitall -> Engine::WaitForAll (src/engine/).
     """
+    # errors must surface at sync points (engine contract): wait_to_read
+    # already wraps async XLA failures as MXNetError — propagate everything
     for arr in list(_LIVE):
-        try:
-            arr.wait_to_read()
-        except MXNetError:
-            raise
-        except Exception:
-            pass
+        arr.wait_to_read()
 
 
 class NDArray:

@@ -117,8 +117,26 @@ class ShardedOptimizerUpdater:
         pad = (-size) % n
         return pad
 
+    def _put(self, host, sharding):
+        """Place a host array with `sharding` without cross-host transfers.
+
+        ``jax.device_put(x, sharding)`` raises in a multi-process job when
+        the sharding spans non-addressable devices; build the global array
+        from each process's addressable shards instead (every process holds
+        the full value, the callback slices out the local shards)."""
+        import jax
+        import jax.numpy as jnp
+
+        if jax.process_count() == 1:
+            return jax.device_put(jnp.asarray(host), sharding)
+        host = _np.asarray(host)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+
     # -- jit step ----------------------------------------------------------
-    def _make_step(self, shape, dtype):
+    def _make_step(self, shape, dtype, clip):
+        """clip (clip_gradient, or None) is static: it selects whether the
+        clamp appears in the program, mirroring ops/optimizer_ops.py _prep."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -136,58 +154,55 @@ class ShardedOptimizerUpdater:
             xf = jnp.pad(x.reshape(-1), (0, pad))
             return lax.with_sharding_constraint(xf, shard)
 
+        def prep(gstack, wf, wd, rescale):
+            # sum over the per-device contributions: feeding a sharded
+            # consumer, GSPMD lowers this to a reduce-scatter.  Same
+            # rescale -> clip -> +wd*w order as ops/optimizer_ops.py _prep.
+            g = gstack.sum(axis=0) * (1.0 / n_local) * rescale
+            gf = to_shard(g)
+            if clip is not None:
+                gf = jnp.clip(gf, -clip, clip)
+            return gf + wd * wf
+
         if kind == "sgd":
             def step(w, gstack, mom, lr, wd, mu, rescale):
-                # sum over the per-device contributions: feeding a sharded
-                # consumer, GSPMD lowers this to a reduce-scatter
-                g = gstack.sum(axis=0) * (1.0 / n_local) * rescale
-                gf = to_shard(g)
                 wf = to_shard(w)
-                gf = gf + wd * wf
-                mom_new = mu * mom + gf
-                wf_new = wf - lr * mom_new
+                gf = prep(gstack, wf, wd, rescale)
+                # lr folds into the momentum buffer exactly like the dense
+                # sgd_mom_update kernel, so lr schedules keep trajectories
+                # identical to single-process training
+                mom_new = mu * mom - lr * gf
+                wf_new = wf + mom_new
                 w_new = wf_new[:size].reshape(shape)  # replicated out ⇒ all-gather
                 return w_new, (mom_new,)
-
-            n_state = 1
         else:  # adam
-            def step(w, gstack, m, v, t, lr, wd, b1, b2, eps, rescale):
-                g = gstack.sum(axis=0) * (1.0 / n_local) * rescale
-                gf = to_shard(g)
+            def step(w, gstack, m, v, lr_t, wd, b1, b2, eps, rescale):
+                # lr_t carries the bias correction (frontend folds it, see
+                # optimizer.Adam.update); eps sits outside the raw sqrt(v),
+                # matching ops/optimizer_ops.py adam_update
                 wf = to_shard(w)
-                gf = gf + wd * wf
-                t_new = t + 1
+                gf = prep(gstack, wf, wd, rescale)
                 m_new = b1 * m + (1 - b1) * gf
                 v_new = b2 * v + (1 - b2) * gf * gf
-                c1 = 1 - b1 ** t_new.astype(jnp.float32)
-                c2 = 1 - b2 ** t_new.astype(jnp.float32)
-                wf_new = wf - lr * (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+                wf_new = wf - lr_t * m_new / (jnp.sqrt(v_new) + eps)
                 w_new = wf_new[:size].reshape(shape)
-                return w_new, (m_new, v_new, t_new)
+                return w_new, (m_new, v_new)
 
-            n_state = 2  # t handled separately (scalar)
-
-        out_state_shardings = (shard,) * n_state
-        if kind == "adam":
-            out_state_shardings = (shard, shard, repl)
-        jitted = jax.jit(step, out_shardings=(repl, out_state_shardings))
+        n_state = 1 if kind == "sgd" else 2
+        jitted = jax.jit(step, out_shardings=(repl, (shard,) * n_state))
         return jitted, pad, size
 
     def _init_state(self, key, shape, dtype):
-        import jax
-        import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = self._get_mesh()
         size = int(_np.prod(shape)) if shape else 1
         pad = self._flat_spec(size)
         shard = NamedSharding(mesh, P("w"))
-        zeros = jax.device_put(jnp.zeros(size + pad, dtype), shard)
+        zeros = _np.zeros(size + pad, dtype)
         if self._kind == "sgd":
-            return (zeros,)
-        t0 = jax.device_put(jnp.zeros((), "int32"),
-                            NamedSharding(mesh, P()))
-        return (zeros, jax.device_put(jnp.zeros(size + pad, dtype), shard), t0)
+            return (self._put(zeros, shard),)
+        return (self._put(zeros, shard), self._put(zeros.copy(), shard))
 
     def _stack_contributions(self, g):
         """Build the global (num_global_devices, ...) contribution array:
@@ -206,22 +221,28 @@ class ShardedOptimizerUpdater:
         return jax.make_array_from_process_local_data(
             NamedSharding(mesh, P("w")), _np.asarray(local))
 
-    # -- the updater interface (matches opt_mod.get_updater's calling seam) --
-    def __call__(self, index, grad_nd, weight_nd):
+    def _replicate_weight(self, w):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        repl = NamedSharding(self._get_mesh(), P())
+        if isinstance(w, jax.Array) and w.sharding == repl:
+            return w  # steady state: the previous jit output is already global
+        if jax.process_count() == 1:
+            return jax.device_put(w, repl)
+        return self._put(_np.asarray(w), repl)
+
+    # -- the updater interface (matches opt_mod.get_updater's calling seam) --
+    def __call__(self, index, grad_nd, weight_nd):
         opt = self.optimizer
         key = index
-        # replicate the weight over the mesh (it arrives committed to one
-        # device; the jit output is replicated so steady-state is a no-op)
-        w = jax.device_put(weight_nd._get(),
-                           NamedSharding(self._get_mesh(), P()))
+        w = self._replicate_weight(weight_nd._get())
         g = grad_nd._get()
         shape, dtype = tuple(w.shape), w.dtype
-        sig = (key, shape, str(dtype))
+        clip = opt.clip_gradient if (opt.clip_gradient or 0) > 0 else None
+        sig = (key, shape, str(dtype), clip)
         if sig not in self._jits:
-            self._jits[sig] = self._make_step(shape, dtype)
+            self._jits[sig] = self._make_step(shape, dtype, clip)
         jitted, pad, size = self._jits[sig]
         if key not in self._state:
             self._state[key] = self._init_state(key, shape, dtype)
@@ -236,11 +257,15 @@ class ShardedOptimizerUpdater:
                                        getattr(opt, "momentum", 0.0), rescale)
             self._state[key] = (mom_new,)
         else:
-            m, v, t = self._state[key]
-            w_new, (m2, v2, t2) = jitted(w, gstack, m, v, t, lr, wd,
-                                         opt.beta1, opt.beta2, opt.epsilon,
-                                         rescale)
-            self._state[key] = (m2, v2, t2)
+            import math
+
+            t = opt._index_update_count[index]
+            lr_t = lr * math.sqrt(1.0 - opt.beta2 ** t) / (1.0 - opt.beta1 ** t)
+            m, v = self._state[key]
+            w_new, (m2, v2) = jitted(w, gstack, m, v, lr_t, wd,
+                                     opt.beta1, opt.beta2, opt.epsilon,
+                                     rescale)
+            self._state[key] = (m2, v2)
         weight_nd._set(w_new)
 
     # -- state io (Trainer.save_states compatibility) ----------------------
@@ -256,8 +281,6 @@ class ShardedOptimizerUpdater:
 
     def set_states(self, blob):
         import pickle
-        import jax
-        import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         payload = pickle.loads(blob)
@@ -265,10 +288,16 @@ class ShardedOptimizerUpdater:
         shard = NamedSharding(mesh, P("w"))
         restored = {}
         for k, states in payload["state"].items():
+            if payload.get("kind", self._kind) == "adam" and len(states) == 3:
+                # legacy blob layout (m, v, t): t now lives in the
+                # optimizer's update count, keyed like the dense path
+                m, v, t = states
+                states = (m, v)
+                self.optimizer._index_update_count[k] = int(_np.asarray(t))
             rs = []
             for s in states:
-                arr = jnp.asarray(s)
-                rs.append(jax.device_put(
+                arr = _np.asarray(s)
+                rs.append(self._put(
                     arr, shard if arr.ndim else NamedSharding(mesh, P())))
             restored[k] = tuple(rs)
         self._state = restored

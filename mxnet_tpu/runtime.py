@@ -34,7 +34,7 @@ class Features(dict):
             "SIGNAL_HANDLER": True,
             "MKLDNN": False,
             "OPENCV": False,
-            "SPARSE": False,  # flips on when the sparse subsystem lands
+            "SPARSE": True,  # ndarray/sparse.py: row_sparse/csr + kvstore path
         }
         for k, v in feats.items():
             self[k] = Feature(k, v)

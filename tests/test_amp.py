@@ -159,6 +159,26 @@ def test_batch_norm_bf16_fp32_stats():
     assert mm.data().dtype == np.float32
 
 
+def test_convert_model_keeps_bn_stats_fp32():
+    """convert_model must exclude this repo's BN stat names
+    (running_mean/running_var), not only the reference's moving_* names
+    (ADVICE r2: silent cast of BN statistics)."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=4), gluon.nn.BatchNorm())
+    net.initialize()
+    net(mx.nd.random.uniform(shape=(2, 4)))
+    amp.convert_model(net, "bfloat16")
+    params = net.collect_params()
+    for name, p in params.items():
+        want_fp32 = any(name.endswith(s) for s in
+                        ("gamma", "beta", "running_mean", "running_var"))
+        got = str(p.data().dtype)
+        if want_fp32:
+            assert got == "float32", (name, got)
+        else:
+            assert got == "bfloat16", (name, got)
+
+
 def test_unscale_is_one_shot_and_preserves_dynamic_scale():
     net = _mlp()
     amp.init("float16")

@@ -1,0 +1,65 @@
+"""Engine controls: determinism switch + matmul precision policy
+(reference: MXNET_ENGINE_TYPE=NaiveEngine env switch, SURVEY.md §5 oracle 5,
+§6.6 env-var layer)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, nd
+
+
+def test_default_engine_type():
+    assert engine.engine_type() == "ThreadedEnginePerDevice"
+
+
+def test_naive_engine_scoped_and_consistent():
+    """NaiveEngine (eager, jit disabled) must compute the same numbers."""
+    import jax
+
+    x = np.random.RandomState(0).randn(4, 8).astype("f")
+    net = mx.gluon.nn.Dense(3, in_units=8)
+    net.initialize()
+    net.hybridize()
+    fused = net(nd.array(x)).asnumpy()
+    with engine.naive_engine():
+        assert engine.engine_type() == "NaiveEngine"
+        assert jax.config.jax_disable_jit
+        naive = net(nd.array(x)).asnumpy()
+    assert engine.engine_type() == "ThreadedEnginePerDevice"
+    assert not jax.config.jax_disable_jit
+    np.testing.assert_allclose(fused, naive, rtol=1e-5, atol=1e-6)
+
+
+def test_set_engine_type_global():
+    import jax
+
+    engine.set_engine_type("NaiveEngine")
+    try:
+        assert jax.config.jax_disable_jit
+    finally:
+        engine.set_engine_type("ThreadedEnginePerDevice")
+    assert not jax.config.jax_disable_jit
+
+
+def test_matmul_precision_validation():
+    with pytest.raises(mx.MXNetError):
+        engine.set_matmul_precision("not-a-precision")
+    # valid settings round-trip without error
+    engine.set_matmul_precision("high")
+    engine.set_matmul_precision("highest")
+
+
+def test_waitall_propagates_errors():
+    """waitall must surface async errors, not swallow them (engine
+    contract: errors appear at sync points)."""
+    a = nd.ones((4,))
+    ok = nd.ones((2,))
+    raised = False
+    try:
+        b = nd.Convolution(a, a, kernel=(3, 3), num_filter=1)  # bad rank
+        nd.waitall()
+    except Exception:
+        raised = True
+    assert raised
+    # session survives, other arrays still usable
+    assert ok.asnumpy().sum() == 2

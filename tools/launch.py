@@ -33,6 +33,7 @@ import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port():
@@ -88,14 +89,32 @@ def main(argv=None):
                 k, _, v = kv.partition("=")
                 env[k] = v
             procs.append(subprocess.Popen(cmd, env=env))
+        # poll the whole group: one worker dying early must tear the job
+        # down immediately (a sequential wait() would hang forever on the
+        # survivors blocked in collectives)
         rc = 0
-        for p in procs:
-            rc = p.wait() or rc
+        running = list(procs)
+        while running:
+            for p in running[:]:
+                r = p.poll()
+                if r is not None:
+                    running.remove(p)
+                    rc = rc or r
+            if rc:
+                break
+            time.sleep(0.2)
         return rc
     finally:
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
 
 
 if __name__ == "__main__":
