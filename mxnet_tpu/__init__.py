@@ -54,6 +54,7 @@ from . import symbol as sym
 from .executor import Executor
 from . import module
 from . import module as mod
+from . import operator
 from . import model
 from . import gluon
 from . import io

@@ -34,6 +34,7 @@ __all__ = [
     "mark_variables",
     "backward",
     "grad",
+    "Function",
 ]
 
 
@@ -413,3 +414,153 @@ def _zeros_like(x):
     import jax.numpy as jnp
 
     return jnp.zeros(x.shape, x.dtype)
+
+
+# --------------------------------------------------------------------------
+# user-defined differentiable functions
+# --------------------------------------------------------------------------
+def record_callback_node(in_entries, out_nds, backward_cb, name, ctx=None):
+    """Attach a tape node to ``out_nds`` whose vjp is a host callback.
+
+    Shared wiring for CustomOp and Function: ``backward_cb`` receives the
+    output-gradient NDArrays and returns per-input cotangents
+    (NDArray / jax array / None), aligned with ``in_entries``."""
+    from .ndarray.ndarray import NDArray
+
+    def vjp_fn(cotangents):
+        import jax.numpy as jnp
+
+        cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+        grads = backward_cb([NDArray._from_jax(jnp.asarray(c), ctx)
+                             for c in cots])
+        return tuple(
+            None if g is None else
+            (g._get() if hasattr(g, "_get") else jnp.asarray(g))
+            for g in grads)
+
+    avals = [(tuple(o.shape), _np.dtype(str(o.dtype))) for o in out_nds]
+    node = Node(vjp_fn, list(in_entries), avals, name=name,
+                multi=len(out_nds) > 1)
+    node.out_entries = [Entry(node=node, oidx=i, shape=s, dtype=d)
+                        for i, (s, d) in enumerate(avals)]
+    for o, e in zip(out_nds, node.out_entries):
+        o._ag_entry = e
+    return node
+
+
+class Function:
+    """Customized differentiation (reference: ``mx.autograd.Function``,
+    python/mxnet/autograd.py): subclass, implement ``forward`` and
+    ``backward`` over NDArrays, stash residuals with ``save_for_backward``
+    (or plain attributes on ``self``), call the instance like a function.
+
+    Works eagerly (tape node whose vjp calls the user's ``backward`` —
+    full host-Python freedom, matching reference callback semantics) and
+    inside ``hybridize()``/jit traces (staged as a ``jax.custom_vjp``; user
+    code must then be trace-compatible NDArray math)."""
+
+    def __init__(self):
+        self._saved = ()
+
+    # -- user surface ------------------------------------------------------
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    # -- invocation --------------------------------------------------------
+    def __call__(self, *inputs):
+        import jax
+
+        from .ndarray.ndarray import NDArray
+
+        nd_in = [x if isinstance(x, NDArray)
+                 else NDArray._from_jax(_as_jax(x), None)
+                 for x in inputs]
+        in_vals = [a._get() for a in nd_in]
+        if any(isinstance(v, jax.core.Tracer) for v in in_vals):
+            return self._call_traced(nd_in)
+        return self._call_eager(nd_in)
+
+    def _call_eager(self, nd_in):
+        from .ndarray.ndarray import NDArray
+
+        ctx = nd_in[0].context if nd_in else None
+        with pause():
+            out = self.forward(*nd_in)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+
+        if is_recording() and any(a._ag_entry is not None for a in nd_in):
+            fname = type(self).__name__
+
+            def backward_cb(out_grad_nds):
+                with pause():
+                    gin = self.backward(*out_grad_nds)
+                gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+                if len(gin) != len(nd_in):
+                    raise MXNetError(
+                        f"{fname}.backward returned {len(gin)} grads for "
+                        f"{len(nd_in)} inputs")
+                return gin
+
+            record_callback_node([a._ag_entry for a in nd_in], outs,
+                                 backward_cb, f"Function:{fname}", ctx)
+        return tuple(outs) if multi else outs[0]
+
+    def _call_traced(self, nd_in):
+        import jax
+
+        from .ndarray.ndarray import NDArray
+
+        ctx = nd_in[0].context if nd_in else None
+        func = self
+        multi_box = []
+
+        @jax.custom_vjp
+        def fn(*vals):
+            return _fwd(*vals)[0]
+
+        def _fwd(*vals):
+            ins = [NDArray._from_jax(v, ctx) for v in vals]
+            with pause():
+                out = func.forward(*ins)
+            multi = isinstance(out, (tuple, list))
+            if not multi_box:
+                multi_box.append(multi)
+            outs = list(out) if multi else [out]
+            saved = tuple(t._get() for t in func._saved)
+            return tuple(o._get() for o in outs), (vals, saved)
+
+        def _bwd(res, cots):
+            import jax.numpy as jnp
+
+            in_vals, saved = res
+            func._saved = tuple(NDArray._from_jax(s, ctx) for s in saved)
+            grad_nds = [NDArray._from_jax(c, ctx) for c in cots]
+            with pause():
+                gin = func.backward(*grad_nds)
+            gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+            return tuple(
+                jnp.zeros(v.shape, v.dtype) if g is None else
+                (g._get() if hasattr(g, "_get") else jnp.asarray(g))
+                for g, v in zip(gin, in_vals))
+
+        fn.defvjp(_fwd, _bwd)
+        out_vals = fn(*[a._get() for a in nd_in])
+        outs = [NDArray._from_jax(v, ctx) for v in out_vals]
+        return tuple(outs) if multi_box and multi_box[0] else outs[0]
+
+
+def _as_jax(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import re
 import threading
 from collections import OrderedDict
+from contextlib import contextmanager
 
 import numpy as _np
 
@@ -40,11 +41,20 @@ __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 class _BlockScope(threading.local):
     def __init__(self):
         self.counters = {}
+        self.scope_stack = []  # active name_scope() (prefix, counters) pairs
 
     def next_name(self, hint):
-        n = self.counters.get(hint, 0)
-        self.counters[hint] = n + 1
-        return f"{hint}{n}_"
+        if self.scope_stack:
+            # inside `with block.name_scope()`: numbering is per-block
+            # (reference: each Block owns a _BlockScope), so two instances
+            # of the same model class produce identical child names and
+            # save/load round-trips match
+            prefix, counters = self.scope_stack[-1]
+        else:
+            prefix, counters = "", self.counters
+        n = counters.get(hint, 0)
+        counters[hint] = n + 1
+        return f"{prefix}{hint}{n}_"
 
 
 _NAME_SCOPE = _BlockScope()
@@ -93,6 +103,19 @@ class Block:
     @property
     def params(self):
         return self._params
+
+    @contextmanager
+    def name_scope(self):
+        """Names of blocks/params created inside are prefixed with this
+        block's prefix (reference: Block.name_scope — the idiom every Gluon
+        model definition uses).  Numbering restarts per block instance."""
+        if not hasattr(self, "_scope_counters"):
+            self._scope_counters = {}
+        _NAME_SCOPE.scope_stack.append((self._prefix, self._scope_counters))
+        try:
+            yield
+        finally:
+            _NAME_SCOPE.scope_stack.pop()
 
     def __setattr__(self, name, value):
         if isinstance(value, Block):

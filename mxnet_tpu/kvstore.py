@@ -118,8 +118,13 @@ class KVStore:
                 # after a sharded update the stored weight is a global array
                 # over the whole mesh (multi-process or multi-device); it
                 # cannot mix with the single-device row_ids inside one
-                # computation, so read the local replica out first
-                src = NDArray._from_jax(_np.asarray(src_val), src.context)
+                # computation.  Fully-replicated: one addressable shard IS
+                # the value (no host round-trip of the whole table).
+                if sharding.is_fully_replicated:
+                    local = src_val.addressable_data(0)
+                else:  # pragma: no cover - stored weights are replicated
+                    local = _np.asarray(src_val)
+                src = NDArray._from_jax(local, src.context)
             src_local = src.as_in_context(o.context)
             rows = invoke("take", [src_local, r], {"axis": 0, "mode": "clip"})
             o._set(rows._get().astype(o._get().dtype))

@@ -67,6 +67,9 @@ for _name in list(OP_TABLE):
 
 
 
+# user-defined ops (reference: mx.nd.Custom -> src/operator/custom/custom.cc)
+from ..operator import custom as Custom  # noqa: E402
+
 # sub-namespaces (reference: python/mxnet/ndarray/{contrib,linalg,image}.py)
 from . import contrib  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401

@@ -1,0 +1,254 @@
+"""User-defined operators: ``mx.operator.CustomOp`` / ``CustomOpProp`` /
+``register`` and the ``mx.nd.Custom`` entry point.
+
+Reference: ``python/mxnet/operator.py`` + ``src/operator/custom/custom.cc``
+(SURVEY.md §3.2 custom-op row): users subclass CustomOp (imperative
+forward/backward over NDArrays), describe it with a CustomOpProp
+(arguments/outputs/shape/type inference), register it under an op_type
+string, and call it as ``mx.nd.Custom(*data, op_type=...)``.
+
+TPU-native execution model — two paths behind one API:
+
+- **Eager** (concrete NDArray inputs): forward runs immediately as host
+  Python, exactly like the reference's callback into the engine.  If
+  autograd is recording, a tape node is created whose vjp is a callback
+  into the user's ``backward`` — so ``.asnumpy()``/data-dependent Python in
+  user code is fully supported, matching reference semantics.
+- **Traced** (inside ``hybridize()``/``jit``): the op is staged as a
+  ``jax.custom_vjp`` whose fwd/bwd run the user's methods over
+  tracer-backed NDArrays.  User code must then be trace-compatible
+  (NDArray math, no ``.asnumpy()``) — same restriction the reference's
+  CachedOp imposes by bypassing custom ops' async callbacks.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_registry"]
+
+_PROP_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for user operator implementations."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad_req (reference:
+        CustomOp::assign)."""
+        if req == "null":
+            return
+        val = src._get() if hasattr(src, "_get") else src
+        if req in ("write", "inplace"):
+            dst._set(_coerce(val, dst))
+        elif req == "add":
+            dst._set(dst._get() + _coerce(val, dst))
+        else:
+            raise MXNetError(f"unknown req {req!r}")
+
+
+def _coerce(val, dst):
+    import jax.numpy as jnp
+
+    v = jnp.asarray(val)
+    return v.astype(dst.dtype) if str(v.dtype) != str(dst.dtype) else v
+
+
+class CustomOpProp:
+    """Describes a custom op: names, shapes, types, and operator factory."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        t = in_type[0]
+        return (in_type, [t] * len(self.list_outputs()),
+                [t] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under ``op_type=reg_name``
+    (reference: mx.operator.register decorator)."""
+
+    def _do(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _PROP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return _do
+
+
+def get_prop_registry():
+    return dict(_PROP_REGISTRY)
+
+
+# --------------------------------------------------------------------------
+# the mx.nd.Custom entry point
+# --------------------------------------------------------------------------
+def _is_traced(vals):
+    import jax
+
+    return any(isinstance(v, jax.core.Tracer) for v in vals)
+
+
+def custom(*inputs, op_type=None, **kwargs):
+    """``mx.nd.Custom(*inputs, op_type='name', **prop_kwargs)``."""
+    from . import autograd as _ag
+    from .ndarray.ndarray import NDArray
+
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    prop_cls = _PROP_REGISTRY.get(op_type)
+    if prop_cls is None:
+        raise MXNetError(f"custom op {op_type!r} is not registered "
+                         f"(known: {sorted(_PROP_REGISTRY)})")
+    # the reference passes prop kwargs as strings through the C boundary;
+    # here they arrive as-is
+    prop = prop_cls(**kwargs)
+
+    n_in = len(prop.list_arguments())
+    n_aux = len(prop.list_auxiliary_states())
+    n_out = len(prop.list_outputs())
+    if len(inputs) != n_in + n_aux:
+        raise MXNetError(
+            f"custom op {op_type!r} expects {n_in} args + {n_aux} aux, "
+            f"got {len(inputs)} inputs")
+    in_nds = list(inputs[:n_in])
+    aux_nds = list(inputs[n_in:])
+
+    in_shapes = [tuple(a.shape) for a in in_nds]
+    in_types = [_np_dtype(a) for a in in_nds]
+    shapes = prop.infer_shape(in_shapes)
+    out_shapes = list(shapes[1])
+    types = prop.infer_type(in_types)
+    out_types = list(types[1])
+
+    ctx = in_nds[0].context if in_nds else None
+    op = prop.create_operator(ctx, in_shapes, in_types)
+    is_train = _ag.is_training()
+
+    in_vals = [a._get() for a in in_nds]
+    if _is_traced(in_vals + [a._get() for a in aux_nds]):
+        return _custom_traced(op, prop, in_nds, aux_nds, out_shapes,
+                              out_types, n_out, is_train, ctx)
+    return _custom_eager(op, prop, in_nds, aux_nds, out_shapes, out_types,
+                         n_out, is_train, ctx, op_type)
+
+
+def _np_dtype(a):
+    import numpy as np
+
+    return np.dtype(str(a.dtype)) if not isinstance(a.dtype, np.dtype) \
+        else a.dtype
+
+
+def _alloc_outs(out_shapes, out_types, ctx):
+    from .ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+
+    return [NDArray._from_jax(jnp.zeros(s, dtype=t), ctx)
+            for s, t in zip(out_shapes, out_types)]
+
+
+def _custom_eager(op, prop, in_nds, aux_nds, out_shapes, out_types, n_out,
+                  is_train, ctx, op_type):
+    """Immediate host execution + manual tape node (callback backward)."""
+    from . import autograd as _ag
+    from .ndarray.ndarray import NDArray
+
+    out_nds = _alloc_outs(out_shapes, out_types, ctx)
+    req = ["write"] * n_out
+    with _ag.pause():
+        op.forward(is_train, req, in_nds, out_nds, aux_nds)
+
+    recording = _ag.is_recording() and any(
+        a._ag_entry is not None for a in in_nds)
+    if recording:
+        def backward_cb(out_grads):
+            import jax.numpy as jnp
+
+            in_grads = [NDArray._from_jax(jnp.zeros(a.shape, _np_dtype(a)),
+                                          ctx) for a in in_nds]
+            with _ag.pause():
+                op.backward(["write"] * len(in_nds), out_grads, in_nds,
+                            out_nds, in_grads, aux_nds)
+            return list(in_grads) + [None] * len(aux_nds)
+
+        _ag.record_callback_node(
+            [a._ag_entry for a in in_nds] + [None] * len(aux_nds),
+            out_nds, backward_cb, f"Custom:{op_type}", ctx)
+    return out_nds[0] if n_out == 1 else tuple(out_nds)
+
+
+def _custom_traced(op, prop, in_nds, aux_nds, out_shapes, out_types, n_out,
+                   is_train, ctx):
+    """Staged execution inside an enclosing jit trace: jax.custom_vjp whose
+    fwd/bwd run the user's methods over tracer-backed NDArrays."""
+    import jax
+    from . import autograd as _ag
+    from .ndarray.ndarray import NDArray
+
+    n_in = len(in_nds)
+
+    @jax.custom_vjp
+    def fn(*vals):
+        return _fwd(*vals)[0]
+
+    def _fwd(*vals):
+        ins = [NDArray._from_jax(v, ctx) for v in vals[:n_in]]
+        auxs = [NDArray._from_jax(v, ctx) for v in vals[n_in:]]
+        outs = _alloc_outs(out_shapes, out_types, ctx)
+        with _ag.pause():
+            op.forward(is_train, ["write"] * n_out, ins, outs, auxs)
+        out_vals = tuple(o._get() for o in outs)
+        return out_vals, (vals, out_vals)
+
+    def _bwd(res, cots):
+        in_vals, out_vals = res
+        ins = [NDArray._from_jax(v, ctx) for v in in_vals[:n_in]]
+        auxs = [NDArray._from_jax(v, ctx) for v in in_vals[n_in:]]
+        outs = [NDArray._from_jax(v, ctx) for v in out_vals]
+        out_grads = [NDArray._from_jax(c, ctx) for c in cots]
+        import jax.numpy as jnp
+
+        in_grads = [NDArray._from_jax(jnp.zeros(a.shape, _np_dtype(a)), ctx)
+                    for a in ins]
+        with _ag.pause():
+            op.backward(["write"] * n_in, out_grads, ins, outs, in_grads,
+                        auxs)
+        return tuple(g._get() for g in in_grads) + \
+            tuple(jnp.zeros(a.shape, _np_dtype(a)) for a in auxs)
+
+    fn.defvjp(_fwd, _bwd)
+    out_vals = fn(*[a._get() for a in in_nds + aux_nds])
+    out_nds = [NDArray._from_jax(v, ctx) for v in out_vals]
+    return out_nds[0] if n_out == 1 else tuple(out_nds)

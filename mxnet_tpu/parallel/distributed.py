@@ -274,7 +274,10 @@ class ShardedOptimizerUpdater:
 
         host = {k: tuple(_np.asarray(s) for s in v)
                 for k, v in self._state.items()}
-        payload = {"state": host, "kind": self._kind}
+        # version 2: sgd momentum buffer carries the lr-folded form
+        # (mom' = mu*mom - lr*g); adam state is (m, v) with t in the
+        # optimizer's update count
+        payload = {"state": host, "kind": self._kind, "version": 2}
         if dump_optimizer:
             payload["optimizer"] = self.optimizer
         return pickle.dumps(payload)
@@ -284,6 +287,12 @@ class ShardedOptimizerUpdater:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         payload = pickle.loads(blob)
+        if payload.get("version", 1) < 2 and \
+                payload.get("kind", self._kind) == "sgd":
+            raise MXNetError(
+                "optimizer state blob predates the lr-folded sgd momentum "
+                "layout and cannot be migrated (the fold depends on the lr "
+                "at save time); re-save states with the current build")
         mesh = self._get_mesh()
         shard = NamedSharding(mesh, P("w"))
         restored = {}

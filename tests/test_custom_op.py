@@ -1,0 +1,216 @@
+"""User-defined operators: mx.operator.CustomOp + autograd.Function
+(reference: tests/python/unittest/test_operator.py test_custom_op and
+test_autograd.py Function tests — SURVEY.md §3.2 custom-op row)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        y = 1.0 / (1.0 + nd.exp(-x))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _Sigmoid()
+
+
+def test_custom_op_forward_backward_eager():
+    x_np = np.random.RandomState(0).randn(4, 5).astype("f")
+    x = nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid")
+        loss = (y * y).sum()
+    loss.backward()
+    sig = 1.0 / (1.0 + np.exp(-x_np))
+    np.testing.assert_allclose(y.asnumpy(), sig, rtol=1e-5)
+    expect = 2 * sig * sig * (1 - sig)
+    np.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_custom_op_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.ones((2,)), op_type="no_such_op")
+
+
+def test_custom_op_inside_hybridize():
+    """The traced path: Custom stages as jax.custom_vjp inside the jit."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(6, in_units=5))
+    net.initialize()
+
+    x_np = np.random.RandomState(1).randn(3, 5).astype("f")
+
+    def run(hybridized):
+        if hybridized:
+            net.hybridize()
+        x = nd.array(x_np)
+        with autograd.record():
+            h = net(x)
+            y = nd.Custom(h, op_type="test_sigmoid")
+            loss = y.sum()
+        loss.backward()
+        return (y.asnumpy(),
+                list(net.collect_params().values())[0].grad().asnumpy())
+
+    # eager first, then hybridized: outputs and param grads must agree.
+    # (hybridize caches a fresh jit; Custom appears inside the traced fn)
+    y_e, g_e = run(False)
+    y_h, g_h = run(True)
+    np.testing.assert_allclose(y_e, y_h, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(g_e, g_h, rtol=1e-5, atol=1e-6)
+
+
+def test_name_scope_save_load_roundtrip(tmp_path):
+    """Two instances of the same model class must produce identical param
+    names so save/load round-trips (reference: per-Block name scopes)."""
+    class _M(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = gluon.nn.Dense(4, in_units=3)
+
+        def hybrid_forward(self, F, x):
+            return self.d(x)
+
+    m1 = _M(prefix="model_")
+    m1.initialize()
+    m1(nd.ones((1, 3)))
+    f = str(tmp_path / "m.params")
+    m1.save_parameters(f)
+    m2 = _M(prefix="model_")
+    m2.load_parameters(f)
+    assert sorted(m1.collect_params()) == sorted(m2.collect_params())
+    np.testing.assert_allclose(
+        m1(nd.ones((1, 3))).asnumpy(), m2(nd.ones((1, 3))).asnumpy(),
+        rtol=1e-6)
+
+
+class _SquareFn(autograd.Function):
+    def forward(self, x):
+        # host-Python freedom in the eager path (reference callback
+        # semantics): .asnumpy() is allowed here
+        _ = x.asnumpy()
+        y = x * x
+        self.save_for_backward(x)
+        return y
+
+    def backward(self, dy):
+        (x,) = self.saved_tensors
+        return 2.0 * x * dy
+
+
+def test_autograd_function_eager():
+    x = nd.array(np.array([1.0, 2.0, 3.0], "f"))
+    x.attach_grad()
+    f = _SquareFn()
+    with autograd.record():
+        y = f(x)
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), [1, 4, 9], rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6], rtol=1e-6)
+
+
+class _ScaleShift(autograd.Function):
+    """Two inputs, custom (non-autodiff) backward: returns 3*dy for x to
+    prove the custom rule (not jax's) is used."""
+
+    def forward(self, x, w):
+        return x * w
+
+    def backward(self, dy):
+        return 3.0 * dy, dy * 0.0
+
+
+def test_autograd_function_custom_rule_wins():
+    x = nd.ones((3,))
+    w = nd.array(np.array([2.0, 2.0, 2.0], "f"))
+    x.attach_grad()
+    w.attach_grad()
+    f = _ScaleShift()
+    with autograd.record():
+        y = f(x, w)
+        y.sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3, 3, 3], rtol=1e-6)
+    np.testing.assert_allclose(w.grad.asnumpy(), [0, 0, 0], rtol=1e-6)
+
+
+class _TraceSquare(autograd.Function):
+    def forward(self, x):
+        y = x * x
+        self.save_for_backward(x)
+        return y
+
+    def backward(self, dy):
+        (x,) = self.saved_tensors
+        return 2.0 * x * dy
+
+
+class _FnBlock(gluon.HybridBlock):
+    def hybrid_forward(self, F, x):
+        return _TraceSquare()(x) + 1.0
+
+
+def test_autograd_function_inside_hybridize():
+    net = _FnBlock()
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.array([1.0, -2.0, 0.5], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = net(x)
+        y.sum().backward()
+    np.testing.assert_allclose(y.asnumpy(), [2.0, 5.0, 1.25], rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, -4.0, 1.0],
+                               rtol=1e-6)
+
+
+def test_custom_op_multi_output():
+    class _Split(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0]
+            self.assign(out_data[0], req[0], x * 2.0)
+            self.assign(out_data[1], req[1], x + 1.0)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        out_grad[0] * 2.0 + out_grad[1])
+
+    @mx.operator.register("test_split2")
+    class _SplitProp(mx.operator.CustomOpProp):
+        def list_outputs(self):
+            return ["double", "plus1"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0], in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _Split()
+
+    x = nd.array(np.array([1.0, 2.0], "f"))
+    x.attach_grad()
+    with autograd.record():
+        a, b = nd.Custom(x, op_type="test_split2")
+        (a.sum() + (2 * b).sum()).backward()
+    np.testing.assert_allclose(a.asnumpy(), [2, 4], rtol=1e-6)
+    np.testing.assert_allclose(b.asnumpy(), [2, 3], rtol=1e-6)
+    # d/dx [2x + 2(x+1)] = 4
+    np.testing.assert_allclose(x.grad.asnumpy(), [4, 4], rtol=1e-6)
