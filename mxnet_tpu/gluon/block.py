@@ -81,8 +81,14 @@ class Block:
 
     def __init__(self, prefix=None, params=None):
         self._empty_prefix = prefix == ""
-        self._prefix = prefix if prefix is not None else _NAME_SCOPE.next_name(
-            self._alias())
+        if prefix is not None:
+            # an explicit prefix is relative to the enclosing name_scope
+            # (reference: BlockScope.create prepends the current scope)
+            scope = _NAME_SCOPE.scope_stack[-1][0] if \
+                _NAME_SCOPE.scope_stack else ""
+            self._prefix = scope + prefix
+        else:
+            self._prefix = _NAME_SCOPE.next_name(self._alias())
         self._params = ParameterDict(self._prefix, shared=params)
         self._children = OrderedDict()
         self._reg_params = OrderedDict()

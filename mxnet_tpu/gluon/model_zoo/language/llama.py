@@ -64,14 +64,21 @@ class LlamaAttention(HybridBlock):
         super().__init__(**kwargs)
         d, hd = cfg.hidden_size, cfg.head_dim
         self._cfg = cfg
-        self.q_proj = nn.Dense(cfg.num_heads * hd, use_bias=False,
-                               flatten=False, in_units=d)
-        self.k_proj = nn.Dense(cfg.num_kv_heads * hd, use_bias=False,
-                               flatten=False, in_units=d)
-        self.v_proj = nn.Dense(cfg.num_kv_heads * hd, use_bias=False,
-                               flatten=False, in_units=d)
-        self.o_proj = nn.Dense(d, use_bias=False, flatten=False,
-                               in_units=cfg.num_heads * hd)
+        # child names matter: parallel.tensor_parallel's Megatron rules key
+        # on the q/k/v/o_proj suffixes to pick column- vs row-parallel specs
+        with self.name_scope():
+            self.q_proj = nn.Dense(cfg.num_heads * hd, use_bias=False,
+                                   flatten=False, in_units=d,
+                                   prefix="q_proj_")
+            self.k_proj = nn.Dense(cfg.num_kv_heads * hd, use_bias=False,
+                                   flatten=False, in_units=d,
+                                   prefix="k_proj_")
+            self.v_proj = nn.Dense(cfg.num_kv_heads * hd, use_bias=False,
+                                   flatten=False, in_units=d,
+                                   prefix="v_proj_")
+            self.o_proj = nn.Dense(d, use_bias=False, flatten=False,
+                                   in_units=cfg.num_heads * hd,
+                                   prefix="o_proj_")
 
     def hybrid_forward(self, F, x):
         cfg = self._cfg
@@ -94,12 +101,17 @@ class LlamaAttention(HybridBlock):
 class LlamaMLP(HybridBlock):
     def __init__(self, cfg, **kwargs):
         super().__init__(**kwargs)
-        self.gate_proj = nn.Dense(cfg.intermediate_size, use_bias=False,
-                                  flatten=False, in_units=cfg.hidden_size)
-        self.up_proj = nn.Dense(cfg.intermediate_size, use_bias=False,
-                                flatten=False, in_units=cfg.hidden_size)
-        self.down_proj = nn.Dense(cfg.hidden_size, use_bias=False,
-                                  flatten=False, in_units=cfg.intermediate_size)
+        with self.name_scope():
+            self.gate_proj = nn.Dense(cfg.intermediate_size, use_bias=False,
+                                      flatten=False, in_units=cfg.hidden_size,
+                                      prefix="gate_proj_")
+            self.up_proj = nn.Dense(cfg.intermediate_size, use_bias=False,
+                                    flatten=False, in_units=cfg.hidden_size,
+                                    prefix="up_proj_")
+            self.down_proj = nn.Dense(cfg.hidden_size, use_bias=False,
+                                      flatten=False,
+                                      in_units=cfg.intermediate_size,
+                                      prefix="down_proj_")
 
     def hybrid_forward(self, F, x):
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
@@ -108,10 +120,14 @@ class LlamaMLP(HybridBlock):
 class LlamaDecoderLayer(HybridBlock):
     def __init__(self, cfg, **kwargs):
         super().__init__(**kwargs)
-        self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
-        self.self_attn = LlamaAttention(cfg)
-        self.post_attention_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
-        self.mlp = LlamaMLP(cfg)
+        with self.name_scope():
+            self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps,
+                                           prefix="input_layernorm_")
+            self.self_attn = LlamaAttention(cfg, prefix="self_attn_")
+            self.post_attention_layernorm = RMSNorm(
+                cfg.hidden_size, cfg.rms_eps,
+                prefix="post_attention_layernorm_")
+            self.mlp = LlamaMLP(cfg, prefix="mlp_")
 
     def hybrid_forward(self, F, x):
         x = x + self.self_attn(self.input_layernorm(x))
@@ -122,11 +138,14 @@ class LlamaModel(HybridBlock):
     def __init__(self, cfg, **kwargs):
         super().__init__(**kwargs)
         self._cfg = cfg
-        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
-        self.layers = nn.HybridSequential(prefix="")
-        for _ in range(cfg.num_layers):
-            self.layers.add(LlamaDecoderLayer(cfg))
-        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+        with self.name_scope():
+            self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                             prefix="embed_tokens_")
+            self.layers = nn.HybridSequential(prefix="layers_")
+            with self.layers.name_scope():
+                for i in range(cfg.num_layers):
+                    self.layers.add(LlamaDecoderLayer(cfg, prefix=f"{i}_"))
+            self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps, prefix="norm_")
 
     def hybrid_forward(self, F, input_ids):
         h = self.embed_tokens(input_ids)
@@ -138,9 +157,11 @@ class LlamaForCausalLM(HybridBlock):
     def __init__(self, cfg, **kwargs):
         super().__init__(**kwargs)
         self._cfg = cfg
-        self.model = LlamaModel(cfg)
-        self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
-                                flatten=False, in_units=cfg.hidden_size)
+        with self.name_scope():
+            self.model = LlamaModel(cfg, prefix="model_")
+            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                    flatten=False, in_units=cfg.hidden_size,
+                                    prefix="lm_head_")
 
     def hybrid_forward(self, F, input_ids):
         return self.lm_head(self.model(input_ids))

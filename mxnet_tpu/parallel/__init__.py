@@ -4,21 +4,23 @@ This package is the TPU-native replacement for the reference's entire
 communication stack (SURVEY.md §3.3, §6.8) AND the capability upgrade the
 north star requires (TP/FSDP/SP that MXNet 1.x never had):
 
-- mesh:           named Mesh construction (dp/fsdp/tp/sp/ep/pp axes)
-- collectives:    psum/all_gather/reduce_scatter/ppermute/all_to_all wrappers
-- data_parallel:  jit-compiled sharded train step (≙ kvstore 'device' +
-                  Trainer, fused into one XLA program)
-- tensor_parallel: PartitionSpec rules for parameters (Megatron-style)
-- fsdp:           ZeRO-style parameter sharding specs (cf. PAPERS.md
-                  "Automatic Cross-Replica Sharding of Weight Update")
-- ring_attention: sequence-parallel blockwise attention via shard_map+ppermute
+- mesh:            named Mesh construction (dp/fsdp/tp/sp/ep/pp axes)
+- collectives:     psum/all_gather/reduce_scatter/ppermute/all_to_all wrappers
+- data_parallel:   jit-compiled sharded train step (≙ kvstore 'device' +
+                   Trainer, fused into one XLA program); also provides
+                   fsdp_specs (ZeRO-style sharding, cf. PAPERS.md
+                   "Automatic Cross-Replica Sharding of Weight Update")
+- tensor_parallel: Megatron-style column/row PartitionSpec rules
+- distributed:     multi-process bootstrap + sharded-optimizer updater
+- context_parallel: ring attention (sequence parallelism) via ppermute
 """
 from . import mesh
 from . import collectives
 from . import distributed
+from . import tensor_parallel
 from .mesh import make_mesh, get_default_mesh, set_default_mesh
 from .context_parallel import ring_attention, context_parallel_attention
 
-__all__ = ["mesh", "collectives", "distributed", "make_mesh",
-           "get_default_mesh", "set_default_mesh", "ring_attention",
-           "context_parallel_attention"]
+__all__ = ["mesh", "collectives", "distributed", "tensor_parallel",
+           "make_mesh", "get_default_mesh", "set_default_mesh",
+           "ring_attention", "context_parallel_attention"]

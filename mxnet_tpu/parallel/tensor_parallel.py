@@ -1,0 +1,109 @@
+"""Megatron-style tensor parallelism as GSPMD sharding specs.
+
+Capability upgrade over the reference (MXNet 1.x has no TP — SURVEY.md §3.3
+parallelism statement): instead of hand-written column/row-parallel layers
+with explicit allreduces (the Megatron-LM recipe), parameters get
+``PartitionSpec`` annotations over the ``tp`` mesh axis and GSPMD inserts
+the collectives — the sharding-annotation formulation of the same math
+(PAPERS.md / scaling-book recipe):
+
+- **column-parallel** (q/k/v, gate/up projections, lm_head): weight
+  ``(out, in)`` sharded on the out dim → each device computes a head/
+  intermediate slice, no communication on entry.
+- **row-parallel** (o_proj, down_proj): weight sharded on the in dim →
+  partial sums psum'd by GSPMD where the residual stream needs the total.
+- embeddings shard the hidden dim; norms replicate.
+
+Use with ``TrainStep(..., mesh=mesh, extra_param_specs=
+tensor_parallel.megatron_specs(step_params, mesh))`` or standalone through
+``specs_from_rules`` for custom architectures.
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+
+from ..base import MXNetError
+
+__all__ = ["specs_from_rules", "megatron_specs", "MEGATRON_RULES",
+           "validate_specs"]
+
+
+def _P():
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec
+
+
+# (regex searched against the param name, spec template) — templates use the
+# literal string "tp" where the tp axis goes (substituted with the actual
+# axis name at build time); a template without "tp" pins the spec verbatim
+# (e.g. (None,) force-replicates a matching param); position i applies to
+# weight dim i
+MEGATRON_RULES = (
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj|lm_head)_weight$",
+     ("tp", None)),
+    (r"(o_proj|down_proj)_weight$", (None, "tp")),
+    (r"embed_tokens_weight$", (None, "tp")),
+    # biases of column-parallel layers live on the sharded out dim
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj|lm_head)_bias$", ("tp",)),
+)
+
+
+def specs_from_rules(params, rules, mesh, axis="tp", default=None):
+    """Build {name: PartitionSpec} from (regex, template) rules.
+
+    ``params`` maps name -> array-like with ``.shape``.  A rule only
+    applies when the sharded dim is divisible by the axis size; otherwise
+    the param falls back to ``default`` (replicated) — a warning-free
+    degrade matching GSPMD's requirement for even sharding."""
+    P = _P()
+    n = mesh.shape[axis]
+    compiled = [(re.compile(pat), tpl) for pat, tpl in rules]
+    specs = OrderedDict()
+    for name, v in params.items():
+        spec = default if default is not None else P()
+        for pat, tpl in compiled:
+            if pat.search(name):
+                tpl_axes = tuple(axis if t == "tp" else t for t in tpl)
+                if "tp" not in tpl:
+                    # rule pins an explicit spec (e.g. force-replicate)
+                    spec = P(*tpl_axes)
+                else:
+                    sdim = tpl.index("tp")
+                    if len(v.shape) >= len(tpl) and v.shape[sdim] % n == 0:
+                        spec = P(*tpl_axes)
+                break
+        specs[name] = spec
+    return specs
+
+
+def megatron_specs(params, mesh, axis="tp"):
+    """Column/row-parallel specs for transformer params named with the
+    q/k/v/o_proj, gate/up/down_proj, embed_tokens, lm_head convention
+    (model_zoo.language models produce these names)."""
+    if axis not in mesh.shape:
+        raise MXNetError(f"mesh has no {axis!r} axis: {dict(mesh.shape)}")
+    return specs_from_rules(params, MEGATRON_RULES, mesh, axis=axis)
+
+
+def validate_specs(params, specs, mesh):
+    """Check every spec divides its param evenly; raise with the offending
+    names (GSPMD would otherwise fail deep inside compilation)."""
+    bad = []
+    for name, spec in specs.items():
+        v = params.get(name)
+        if v is None:
+            continue
+        for d, ax in enumerate(tuple(spec)):
+            if ax is None:
+                continue
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            n = 1
+            for a in axes:
+                n *= mesh.shape[a]
+            if d >= len(v.shape) or v.shape[d] % n != 0:
+                bad.append((name, tuple(v.shape), tuple(spec)))
+    if bad:
+        raise MXNetError(f"indivisible tensor-parallel specs: {bad}")
+    return True
