@@ -16,7 +16,8 @@ from .base import MXNetError
 from .ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "ImageRecordIter"]
+           "PrefetchingIter", "ImageRecordIter", "CSVIter", "LibSVMIter",
+           "MNISTIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -247,22 +248,109 @@ class PrefetchingIter(DataIter):
         raise NotImplementedError
 
 
+class _DecodePipeline:
+    """Decode/augment pool between the C++ byte reader and batching.
+
+    Reference shape: ``src/io/iter_image_recordio_2.cc`` ParseChunk runs the
+    decode stage on an OMP pool so a single Python thread never bounds
+    throughput (SURVEY.md §4.5).  Here: a feeder thread pulls payload
+    batches from the native reader, fans per-image decode out to a
+    ThreadPoolExecutor (PIL/numpy release the GIL for the heavy parts), and
+    queues assembled batches for ``next()``."""
+
+    def __init__(self, reader, decode_method, n_threads, depth):
+        import queue
+        import threading
+        import weakref
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._reader = reader
+        # weak binding: the running feeder thread must not keep an abandoned
+        # iterator (and its reader/pool/buffers) alive forever
+        self._decode = weakref.WeakMethod(decode_method)
+        self._pool = ThreadPoolExecutor(max_workers=n_threads)
+        self._q = queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        idx = 0
+        while not self._stop.is_set():
+            decode = self._decode()  # WeakMethod: None once the owner died
+            if decode is None:
+                return
+            try:
+                payloads = self._reader.next_batch()
+                if payloads is None:
+                    self._put(None)
+                    return
+                futs = [self._pool.submit(decode, p, idx + i)
+                        for i, p in enumerate(payloads)]
+                idx += len(payloads)
+                results = [f.result() for f in futs]
+            except Exception as e:  # surface read/decode errors at next()
+                self._put(e)
+                return
+            del decode
+            if not self._put(results):
+                return
+
+    def _put(self, item):
+        import queue
+
+        # also abort when the owning iterator has been garbage-collected
+        # (nobody will ever drain the queue)
+        while not self._stop.is_set() and self._decode() is not None:
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def get(self):
+        item = self._q.get()
+        if isinstance(item, Exception):
+            raise MXNetError(f"decode pipeline failed: {item!r}") from item
+        return item
+
+    def shutdown(self):
+        import queue
+
+        self._stop.set()
+        while self._thread.is_alive():
+            try:  # drain so a blocked _put can observe the stop flag
+                self._q.get(timeout=0.05)
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        while True:  # discard whatever is left
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._pool.shutdown(wait=False)
+
+
 class ImageRecordIter(DataIter):
     """Threaded image-record iterator (reference: src/io/iter_image_recordio_2.cc
     "ImageRecordIter" — shard reader → decode pool → batcher → prefetcher).
 
     TPU-native split: the C++ library (mxnet_tpu/native) owns file IO, record
     framing, num_parts/part_index sharding, epoch shuffling and prefetch;
-    decode (PIL/numpy) and augmentation run here.  Supported record payloads:
-    .npy-encoded arrays (recordio.pack_img default) and JPEG/PNG via PIL.
+    decode (PIL/numpy) and augmentation run on a thread pool here
+    (``preprocess_threads``, ≙ the reference's OMP decode stage).  Supported
+    record payloads: .npy-encoded arrays (recordio.pack_img default) and
+    JPEG/PNG via PIL.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, resize=-1, num_parts=1, part_index=0, seed=0,
-                 round_batch=True, prefetch_buffer=4, data_name="data",
-                 label_name="softmax_label", **kwargs):
+                 round_batch=True, prefetch_buffer=4, preprocess_threads=4,
+                 data_name="data", label_name="softmax_label", **kwargs):
         super().__init__(batch_size)
         from .native import NativeRecordReader
         from . import recordio as _rio
@@ -276,13 +364,18 @@ class ImageRecordIter(DataIter):
         self.mean = _np.array([mean_r, mean_g, mean_b], dtype="float32")
         self.std = _np.array([std_r, std_g, std_b], dtype="float32")
         self.round_batch = round_batch
-        self._rng = _np.random.RandomState(seed)
+        self._seed = seed
+        self._epoch = 0
+        self._n_threads = max(int(preprocess_threads), 1)
+        self._depth = prefetch_buffer
         self._reader = NativeRecordReader(
             path_imgrec, batch_size, num_parts=num_parts,
             part_index=part_index, shuffle=shuffle, seed=seed,
             queue_depth=prefetch_buffer)
         self._data_name = data_name
         self._label_name = label_name
+        self._pipeline = _DecodePipeline(self._reader, self._decode,
+                                         self._n_threads, self._depth)
 
     @property
     def provide_data(self):
@@ -296,13 +389,31 @@ class ImageRecordIter(DataIter):
         return [DataDesc(self._label_name, shape)]
 
     def reset(self):
+        self._pipeline.shutdown()
+        self._pipeline = None  # a failed reader.reset() must not leave a
+        #                        dead pipeline that blocks next() forever
         self._reader.reset()
+        self._epoch += 1
+        self._exhausted = False
+        self._pipeline = _DecodePipeline(self._reader, self._decode,
+                                         self._n_threads, self._depth)
 
-    def _decode(self, payload):
+    def close(self):
+        """Stop the decode pool deterministically (also runs when the
+        iterator is garbage-collected via the pipeline's weak binding)."""
+        if getattr(self, "_pipeline", None) is not None:
+            self._pipeline.shutdown()
+            self._pipeline = None
+
+    def _decode(self, payload, index):
+        # per-record RNG keyed by (seed, epoch, index): augmentation is
+        # deterministic regardless of decode-thread scheduling
+        rng = _np.random.RandomState(
+            (self._seed * 1000003 + self._epoch * 7919 + index) % (2 ** 31))
         header, img = self._rio.unpack_img(payload)
-        return self._augment(img), header.label
+        return self._augment(img, rng), header.label
 
-    def _augment(self, img):
+    def _augment(self, img, rng):
         # img HWC uint8/float -> data_shape CHW float32
         c, h, w = self.data_shape
         if img.ndim == 2:
@@ -324,15 +435,15 @@ class ImageRecordIter(DataIter):
             img = self._resize_short(img, self.resize)
         ih, iw = img.shape[:2]
         if self.rand_crop and ih >= h and iw >= w:
-            y0 = self._rng.randint(0, ih - h + 1)
-            x0 = self._rng.randint(0, iw - w + 1)
+            y0 = rng.randint(0, ih - h + 1)
+            x0 = rng.randint(0, iw - w + 1)
         else:
             y0 = max((ih - h) // 2, 0)
             x0 = max((iw - w) // 2, 0)
         img = img[y0:y0 + h, x0:x0 + w]
         if img.shape[0] != h or img.shape[1] != w:
             img = self._resize_exact(img, h, w)
-        if self.rand_mirror and self._rng.rand() < 0.5:
+        if self.rand_mirror and rng.rand() < 0.5:
             img = img[:, ::-1]
         data = img.astype("float32")
         nch = data.shape[2]
@@ -359,14 +470,18 @@ class ImageRecordIter(DataIter):
     def next(self):
         from .ndarray import array as _array
 
-        payloads = self._reader.next_batch()
-        if payloads is None:
+        if getattr(self, "_exhausted", False):
             raise StopIteration
-        imgs, labels = [], []
-        for p in payloads:
-            img, label = self._decode(p)
-            imgs.append(img)
-            labels.append(label)
+        if self._pipeline is None:
+            raise MXNetError(
+                "iterator is closed or a previous reset() failed; "
+                "create a new ImageRecordIter")
+        results = self._pipeline.get()
+        if results is None:
+            self._exhausted = True
+            raise StopIteration
+        imgs = [r[0] for r in results]
+        labels = [r[1] for r in results]
         pad = self.batch_size - len(imgs)
         if pad > 0 and self.round_batch:
             # pad the tail batch with copies of the last record (reference
@@ -378,3 +493,219 @@ class ImageRecordIter(DataIter):
         data = _array(_np.stack(imgs))
         label = _array(_np.asarray(labels, dtype="float32"))
         return DataBatch(data=[data], label=[label], pad=pad)
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (reference: ``src/io/iter_csv.cc`` CSVIter).
+
+    Loads ``data_csv`` (and optional ``label_csv``) into host memory once
+    (the reference streams chunk-wise; at the dataset sizes CSV is used for
+    this is a simplification, not a constraint) and yields batch-size
+    slices, each row reshaped to ``data_shape``.  ``round_batch`` pads the
+    tail batch by wrapping to the head like the reference."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32",
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_shape = tuple(label_shape)
+        self.round_batch = round_batch
+        self._dtype = dtype
+        self._data = _np.loadtxt(data_csv, delimiter=",",
+                                 dtype=dtype, ndmin=2)
+        n = self._data.shape[0]
+        if self._data.shape[1] != int(_np.prod(self.data_shape)):
+            raise MXNetError(
+                f"csv row width {self._data.shape[1]} != data_shape "
+                f"{self.data_shape}")
+        self._data = self._data.reshape((n,) + self.data_shape)
+        if label_csv is not None:
+            self._label = _np.loadtxt(label_csv, delimiter=",", dtype=dtype,
+                                      ndmin=2).reshape((n,) + self.label_shape)
+        else:
+            self._label = _np.zeros((n,) + self.label_shape, dtype=dtype)
+        self._data_name = data_name
+        self._label_name = label_name
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        # a (1,)-wide label squeezes to a vector (matching next())
+        shape = (self.batch_size,) if self.label_shape == (1,) else \
+            (self.batch_size,) + self.label_shape
+        return [DataDesc(self._label_name, shape)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        n = self._data.shape[0]
+        if self._cursor >= n:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        if end > n and not self.round_batch:
+            raise StopIteration
+        # modular take wraps however many times the pad requires
+        ids = _np.arange(self._cursor, end) % n
+        data = self._data[ids]
+        label = self._label[ids]
+        pad = end - n if end > n else 0
+        self._cursor = end
+        lbl = label[:, 0] if self.label_shape == (1,) else label
+        return DataBatch(data=[array(data)], label=[array(lbl)], pad=pad)
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format iterator producing CSR batches (reference:
+    ``src/io/iter_libsvm.cc`` LibSVMIter — the sparse input path for the
+    factorization-machine / linear-model configs, SURVEY.md §3.4)."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 batch_size=1, round_batch=True, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        if len(self.data_shape) != 1:
+            raise MXNetError("LibSVMIter data_shape must be (num_features,)")
+        self.round_batch = round_batch
+        labels, indptr, indices, values = [], [0], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, _, v = tok.partition(":")
+                    indices.append(int(i))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        self._labels = _np.asarray(labels, dtype="float32")
+        self._indptr = _np.asarray(indptr, dtype="int64")
+        self._indices = _np.asarray(indices, dtype="int64")
+        self._values = _np.asarray(values, dtype="float32")
+        if label_libsvm is not None:
+            ext = _np.loadtxt(label_libsvm, dtype="float32", ndmin=1)
+            self._labels = ext.reshape(-1)
+        self._data_name = data_name
+        self._label_name = label_name
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self._label_name, (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def _rows_csr(self, row_ids):
+        """Build a CSR batch from arbitrary row ids — stays sparse, so the
+        tail-batch wrap never densifies a huge feature dim."""
+        from .ndarray.sparse import CSRNDArray
+
+        vals, inds, indptr = [], [], [0]
+        for r in row_ids:
+            lo, hi = self._indptr[r], self._indptr[r + 1]
+            vals.append(self._values[lo:hi])
+            inds.append(self._indices[lo:hi])
+            indptr.append(indptr[-1] + (hi - lo))
+        return CSRNDArray.create(
+            _np.concatenate(vals) if vals else _np.zeros(0, "f"),
+            _np.concatenate(inds) if inds else _np.zeros(0, "i8"),
+            _np.asarray(indptr, dtype="int64"),
+            (len(row_ids), self.data_shape[0]))
+
+    def next(self):
+        n = len(self._labels)
+        if self._cursor >= n:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        if end > n and not self.round_batch:
+            raise StopIteration
+        ids = _np.arange(self._cursor, end) % n  # wraps any pad size
+        csr = self._rows_csr(ids)
+        label = self._labels[ids]
+        pad = end - n if end > n else 0
+        self._cursor = end
+        return DataBatch(data=[csr], label=[array(label)], pad=pad)
+
+
+class MNISTIter(DataIter):
+    """IDX-format MNIST reader (reference: ``src/io/iter_mnist.cc``).
+
+    ``image``/``label`` point at the idx3/idx1 files (optionally .gz)."""
+
+    def __init__(self, image, label, batch_size=1, shuffle=False, flat=False,
+                 seed=0, silent=True, input_shape=None, **kwargs):
+        super().__init__(batch_size)
+        self._images = self._read_idx(image, expect_dims=3)
+        self._labels = self._read_idx(label, expect_dims=1)
+        if self._images.shape[0] != self._labels.shape[0]:
+            raise MXNetError("MNIST image/label count mismatch")
+        self.flat = flat
+        self.shuffle = shuffle
+        self._rng = _np.random.RandomState(seed)
+        self._order = _np.arange(self._images.shape[0])
+        self.reset()
+
+    @staticmethod
+    def _read_idx(path, expect_dims):
+        import gzip
+        import struct
+
+        op = gzip.open if str(path).endswith(".gz") else open
+        with op(path, "rb") as f:
+            raw = f.read()
+        zero, dtype_code, ndim = raw[0] | raw[1], raw[2], raw[3]
+        if zero != 0 or dtype_code != 0x08:
+            raise MXNetError(
+                f"{path} is not a uint8 idx file (magic "
+                f"{raw[:4].hex()}; expected 0000 08 xx)")
+        if ndim != expect_dims:
+            raise MXNetError(f"idx file {path}: expected {expect_dims} dims, "
+                             f"got {ndim}")
+        dims = struct.unpack(">" + "I" * ndim, raw[4:4 + 4 * ndim])
+        data = _np.frombuffer(raw, dtype=_np.uint8, offset=4 + 4 * ndim)
+        return data.reshape(dims)
+
+    def reset(self):
+        self._cursor = 0
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+
+    @property
+    def provide_data(self):
+        h, w = self._images.shape[1:]
+        shape = (self.batch_size, h * w) if self.flat else \
+            (self.batch_size, 1, h, w)
+        return [DataDesc("data", shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def next(self):
+        n = self._images.shape[0]
+        if self._cursor + self.batch_size > n:
+            raise StopIteration
+        ids = self._order[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
+        imgs = self._images[ids].astype("float32") / 255.0
+        if self.flat:
+            imgs = imgs.reshape(self.batch_size, -1)
+        else:
+            imgs = imgs[:, None, :, :]
+        return DataBatch(data=[array(imgs)],
+                         label=[array(self._labels[ids].astype("float32"))],
+                         pad=0)
