@@ -1,2 +1,3 @@
 """Contrib namespace (reference: python/mxnet/contrib/ — SURVEY.md §3.5)."""
 from . import amp  # noqa: F401
+from . import quantization  # noqa: F401

@@ -1,0 +1,304 @@
+"""Post-training INT8 quantization for Gluon models.
+
+Reference: ``python/mxnet/contrib/quantization.py`` (``quantize_model`` /
+``quantize_net``: graph pass replacing FC/conv with quantized kernels +
+naive-minmax or KL-entropy calibration — SURVEY.md §3.2 quantization row).
+
+TPU-native shape: instead of a symbol-graph rewrite, Dense/Conv2D children
+are swapped for Quantized blocks whose forward runs the fused int8 ops
+(``ops/quantization_ops.py``: int8 x int8 -> int32 on the MXU, fp32
+epilogue).  Weights are per-output-channel symmetric int8; activations use
+per-tensor calibrated ranges (naive min/max or KL-optimal thresholds, the
+same two calib_modes the reference ships).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D",
+           "optimal_threshold_kl"]
+
+
+def optimal_threshold_kl(data, num_bins=1001, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| for symmetric int8 (reference:
+    _LayerHistogramCollector + _get_optimal_threshold, the TensorRT-style
+    entropy calibration).  Returns the threshold t: [-t, t] is quantized."""
+    a = _np.abs(_np.asarray(data, dtype="float64").ravel())
+    amax = float(a.max()) if a.size else 0.0
+    if amax <= 0:
+        return 1e-8
+    hist, edges = _np.histogram(a, bins=num_bins, range=(0.0, amax))
+    total = hist.sum()
+    if total == 0:
+        return amax
+
+    best_t, best_kl = amax, _np.inf
+    # candidate thresholds sweep the top half of the histogram
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max((num_bins - num_quantized_bins) // 64, 1)):
+        sliced = hist[:i].astype("float64")
+        # P: the reference distribution with clipped mass folded into the
+        # last bin; Q: the UNCLIPPED slice quantized to int8 resolution and
+        # expanded back.  (Building Q from the clipped P would hide the
+        # clipping error and the search would collapse to tiny thresholds.)
+        p = sliced.copy()
+        p[-1] += hist[i:].sum()
+        if p.sum() == 0:
+            continue
+        q = _np.zeros(i, dtype="float64")
+        factor = i / num_quantized_bins
+        for j in range(num_quantized_bins):
+            lo = int(_np.floor(j * factor))
+            hi = max(int(_np.ceil((j + 1) * factor)), lo + 1)
+            hi = min(hi, i)
+            mass = sliced[lo:hi].sum()
+            nz = (sliced[lo:hi] > 0).sum()
+            if nz:
+                q[lo:hi] = _np.where(sliced[lo:hi] > 0, mass / nz, 0.0)
+        pn = p / p.sum()
+        qs = q.sum()
+        if qs == 0:
+            continue
+        qn = q / qs
+        # D_KL(P||Q) with the standard smoothing for q=0, p>0 bins
+        eps = 1e-10
+        mask = pn > 0
+        kl = float(_np.sum(pn[mask] * _np.log(pn[mask] /
+                                              _np.maximum(qn[mask], eps))))
+        if kl < best_kl:
+            best_kl = kl
+            best_t = edges[i] if i < len(edges) else amax
+    return float(best_t)
+
+
+class _Calib:
+    """Per-layer activation-range collector."""
+
+    def __init__(self, mode):
+        self.mode = mode
+        self.minmax = {}
+        self.samples = {}
+
+    def observe(self, key, arr):
+        a = _np.asarray(arr)
+        lo, hi = float(a.min()), float(a.max())
+        if key in self.minmax:
+            plo, phi = self.minmax[key]
+            self.minmax[key] = (min(lo, plo), max(hi, phi))
+        else:
+            self.minmax[key] = (lo, hi)
+        if self.mode == "entropy":
+            self.samples.setdefault(key, []).append(
+                a.ravel()[:: max(a.size // 8192, 1)].copy())
+
+    def range_of(self, key):
+        lo, hi = self.minmax[key]
+        if self.mode == "entropy":
+            t = optimal_threshold_kl(_np.concatenate(self.samples[key]))
+            return -t, t
+        amax = max(abs(lo), abs(hi))
+        return -amax, amax
+
+
+def _quantize_weight(w):
+    """Per-output-channel symmetric int8: returns (int8 weight, fp32
+    scales of shape (out_channels,))."""
+    w = _np.asarray(w, dtype="float32")
+    flat = w.reshape(w.shape[0], -1)
+    amax = _np.maximum(_np.abs(flat).max(axis=1), 1e-12)
+    scale = amax / 127.0
+    q = _np.clip(_np.round(flat / scale[:, None]), -127, 127).astype("int8")
+    return q.reshape(w.shape), scale.astype("float32")
+
+
+def _import_hybrid_block():
+    from ..gluon.block import HybridBlock
+
+    return HybridBlock
+
+
+class _QuantizedLayer:
+    """Shared state for int8 layers: quantized weight, per-channel scales,
+    fp32 bias, calibrated activation range, optional fused activation."""
+
+    def _setup(self, wq, wscale, bias, act_range, act):
+        from .. import ndarray as nd
+
+        self._act_min, self._act_max = act_range
+        self._wq = nd.array(wq.astype("float32")).astype("int8")
+        self._wscale = nd.array(wscale)
+        self._bias = nd.array(bias) if bias is not None else None
+        self.act = act  # Block.__setattr__ registers it as a child
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(act_range=({self._act_min:.4g}, "
+                f"{self._act_max:.4g}))")
+
+
+def _define_layers():
+    HybridBlock = _import_hybrid_block()
+
+    class QuantizedDense(_QuantizedLayer, HybridBlock):
+        """INT8 Dense (reference: quantized FC kernel)."""
+
+        def __init__(self, wq, wscale, bias, act_range, act=None,
+                     flatten=True, **kw):
+            HybridBlock.__init__(self, **kw)
+            self._flatten = flatten
+            self._setup(wq, wscale, bias, act_range, act)
+
+        @classmethod
+        def from_dense(cls, orig, act_range):
+            wq, wscale = _quantize_weight(orig.weight.data().asnumpy())
+            bias = orig.bias.data().asnumpy() if orig.bias is not None \
+                else None
+            return cls(wq, wscale, bias, act_range, act=orig.act,
+                       flatten=orig._flatten, prefix=orig.prefix + "int8_")
+
+        def hybrid_forward(self, F, x):
+            args = [x, self._wq, self._wscale]
+            if self._bias is not None:
+                args.append(self._bias)
+            y = F._contrib_quantized_fully_connected(
+                *args, act_min=self._act_min, act_max=self._act_max,
+                no_bias=self._bias is None, flatten=self._flatten)
+            return self.act(y) if self.act is not None else y
+
+    class QuantizedConv2D(_QuantizedLayer, HybridBlock):
+        """INT8 NCHW convolution (reference: quantized conv kernel)."""
+
+        def __init__(self, wq, wscale, bias, act_range, conv_kwargs,
+                     act=None, **kw):
+            HybridBlock.__init__(self, **kw)
+            self._conv_kwargs = dict(conv_kwargs)
+            self._setup(wq, wscale, bias, act_range, act)
+
+        @classmethod
+        def from_conv(cls, orig, act_range):
+            wq, wscale = _quantize_weight(orig.weight.data().asnumpy())
+            bias = orig.bias.data().asnumpy() if orig.bias is not None \
+                else None
+            return cls(wq, wscale, bias, act_range, orig._kwargs,
+                       act=orig.act, prefix=orig.prefix + "int8_")
+
+        def hybrid_forward(self, F, x):
+            kw = self._conv_kwargs
+            args = [x, self._wq, self._wscale]
+            if self._bias is not None:
+                args.append(self._bias)
+            y = F._contrib_quantized_conv(
+                *args, act_min=self._act_min, act_max=self._act_max,
+                kernel=kw["kernel"], stride=kw["stride"], pad=kw["pad"],
+                dilate=kw["dilate"], num_filter=kw["num_filter"],
+                num_group=kw["num_group"], no_bias=self._bias is None)
+            return self.act(y) if self.act is not None else y
+
+    return QuantizedDense, QuantizedConv2D
+
+
+QuantizedDense, QuantizedConv2D = _define_layers()
+
+
+def _target_layers(block, exclude):
+    """(parent, child_key, layer) for every quantizable descendant."""
+    from ..gluon import nn
+
+    out = []
+    for key, child in block._children.items():
+        if isinstance(child, nn.Dense) or type(child).__name__ == "Conv2D":
+            if child.name not in exclude:
+                out.append((block, key, child))
+        else:
+            out.extend(_target_layers(child, exclude))
+    return out
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=(),
+                 quantize_mode="smart", num_calib_batches=None, logger=None):
+    """Post-training-quantize a Gluon net in place and return it.
+
+    calib_data: iterable of input batches (NDArray/ndarray) for activation
+    range calibration; calib_mode 'naive' (min/max) or 'entropy' (KL).
+    Dense and Conv2D children are replaced by int8 blocks; everything else
+    (BN, pooling, activations) stays fp32 — the reference's partitioning
+    makes the same split.  quantize_mode 'smart' (default, like the
+    reference) keeps the final output layer fp32 — saturating the logits
+    layer is what flips confident predictions; 'full' quantizes all."""
+    from .. import autograd
+    from ..ndarray.ndarray import NDArray
+    from ..ndarray import array
+
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 is supported")
+    if calib_mode not in ("naive", "entropy"):
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+    if quantize_mode not in ("smart", "full"):
+        raise MXNetError(f"unknown quantize_mode {quantize_mode!r}")
+    if calib_data is None:
+        raise MXNetError("calibration data is required (post-training "
+                         "quantization observes activation ranges)")
+
+    targets = _target_layers(net, set(exclude_layers))
+    if not targets:
+        raise MXNetError("no quantizable Dense/Conv2D layers found")
+    if quantize_mode == "smart" and len(targets) > 1:
+        targets = targets[:-1]  # the last quantizable layer feeds the loss
+
+    # 1. calibration pass: observe each target layer's INPUT range.
+    # Hybridized execution would bypass the child hooks (the cached jit
+    # runs as one program), so calibration runs the eager path; the
+    # caller's hybridization state is restored afterwards.
+    def _collect_active(b, out):
+        if hasattr(b, "_active"):
+            out.append((b, b._active))
+        for c in b._children.values():
+            _collect_active(c, out)
+
+    prev_active = []
+    _collect_active(net, prev_active)
+    net.hybridize(False)
+    calib = _Calib(calib_mode)
+    handles = []
+    for _, _, layer in targets:
+        handles.append(layer.register_forward_pre_hook(
+            (lambda lyr: lambda blk, inputs:
+             calib.observe(lyr.name, inputs[0].asnumpy()))(layer)))
+    with autograd.pause():
+        for i, batch in enumerate(calib_data):
+            if num_calib_batches is not None and i >= num_calib_batches:
+                break
+            x = batch if isinstance(batch, NDArray) else array(batch)
+            net(x)
+    for h in handles:
+        h.detach()
+    missing = [l.name for _, _, l in targets if l.name not in calib.minmax]
+    if missing:
+        raise MXNetError(f"calibration never reached layers {missing}; "
+                         "pass calib_data that exercises the whole net")
+
+    # 2. swap in quantized blocks
+    for parent, key, layer in targets:
+        rng = calib.range_of(layer.name)
+        q = QuantizedDense.from_dense(layer, rng) \
+            if type(layer).__name__ == "Dense" \
+            else QuantizedConv2D.from_conv(layer, rng)
+        parent._children[key] = q
+        for attr, val in list(vars(parent).items()):
+            if val is layer:
+                object.__setattr__(parent, attr, q)
+    # restore the caller's hybridization state (new quantized blocks adopt
+    # their parent's state) and invalidate caches up the tree
+    for b, active in prev_active:
+        if active:
+            b.hybridize(True)
+
+    def _bump(b):
+        b._bump_cache_version()
+        for c in b._children.values():
+            _bump(c)
+
+    _bump(net)
+    return net

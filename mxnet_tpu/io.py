@@ -310,9 +310,20 @@ class _DecodePipeline:
         return False
 
     def get(self):
+        # once terminal (exhausted or errored) the feeder is gone: replay
+        # the terminal state instead of blocking on an empty queue forever
+        done = getattr(self, "_done", None)
+        if done is not None:
+            if isinstance(done, Exception):
+                raise MXNetError(
+                    f"decode pipeline failed: {done!r}") from done
+            return None
         item = self._q.get()
         if isinstance(item, Exception):
+            self._done = item
             raise MXNetError(f"decode pipeline failed: {item!r}") from item
+        if item is None:
+            self._done = True
         return item
 
     def shutdown(self):
@@ -389,7 +400,8 @@ class ImageRecordIter(DataIter):
         return [DataDesc(self._label_name, shape)]
 
     def reset(self):
-        self._pipeline.shutdown()
+        if self._pipeline is not None:  # may be closed / previously failed
+            self._pipeline.shutdown()
         self._pipeline = None  # a failed reader.reset() must not leave a
         #                        dead pipeline that blocks next() forever
         self._reader.reset()
@@ -592,7 +604,12 @@ class LibSVMIter(DataIter):
         self._values = _np.asarray(values, dtype="float32")
         if label_libsvm is not None:
             ext = _np.loadtxt(label_libsvm, dtype="float32", ndmin=1)
-            self._labels = ext.reshape(-1)
+            ext = ext.reshape(-1)
+            if ext.shape[0] != len(labels):
+                raise MXNetError(
+                    f"label file has {ext.shape[0]} rows but data file has "
+                    f"{len(labels)}")
+            self._labels = ext
         self._data_name = data_name
         self._label_name = label_name
         self.reset()

@@ -148,3 +148,23 @@ def cond(pred, then_func, else_func, inputs=None):
     res = apply_fn(pure, inputs_list, name="cond")
     res = res if isinstance(res, (list, tuple)) else [res]
     return res[0] if meta["out_single"] else list(res)
+
+
+# expose every registered _contrib_* op as mx.nd.contrib.<name> (reference:
+# the contrib namespace codegen in python/mxnet/ndarray/register.py)
+def _bind_contrib_ops():
+    import sys as _sys
+
+    from ..ops.registry import OP_TABLE
+
+    mod = _sys.modules[__name__]
+    from . import _make_op_func
+
+    for _name, _od in OP_TABLE.items():
+        if _name.startswith("_contrib_"):
+            short = _name[len("_contrib_"):]
+            if not hasattr(mod, short):
+                setattr(mod, short, _make_op_func(_name, _od))
+
+
+_bind_contrib_ops()

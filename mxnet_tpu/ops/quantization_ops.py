@@ -1,0 +1,153 @@
+"""INT8 quantization operators.
+
+Reference: ``src/operator/quantization/{quantize,quantize_v2,dequantize,
+requantize}-inl.h`` and the quantized conv/FC kernels (SURVEY.md §3.2
+quantization row).  TPU-native design: symmetric int8 with power-free
+scales, int8 x int8 -> int32 matmuls through ``lax.dot_general``/
+``conv_general_dilated`` with ``preferred_element_type=int32`` (XLA maps
+these onto the MXU's native int8 path), and scale/bias epilogues left to
+XLA fusion instead of hand-fused kernels.
+"""
+from __future__ import annotations
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _symmetric_scale(min_range, max_range, qmax=127.0):
+    jnp = _jnp()
+
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return jnp.maximum(amax, 1e-12) / qmax
+
+
+@register("_contrib_quantize_v2", nout=3, differentiable=False,
+          aliases=("quantize_v2",))
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """fp32 -> int8 given a calibrated range (reference: quantize_v2).
+
+    Symmetric: scale = max(|min|,|max|)/127, q = round(x/scale) clipped.
+    Returns (quantized, min_range, max_range) like the reference."""
+    jnp = _jnp()
+
+    if min_calib_range is None or max_calib_range is None:
+        lo = jnp.min(data)
+        hi = jnp.max(data)
+    else:
+        lo = jnp.float32(min_calib_range)
+        hi = jnp.float32(max_calib_range)
+    scale = _symmetric_scale(lo, hi)
+    q = jnp.clip(jnp.round(data / scale), -127, 127).astype(jnp.int8)
+    amax = scale * 127.0
+    return q, -amax, amax
+
+
+@register("_contrib_quantize", nout=3, differentiable=False,
+          aliases=("quantize",))
+def quantize(data, min_range, max_range, out_type="int8"):
+    """fp32 -> int8 with the range provided as arrays (reference:
+    quantize)."""
+    jnp = _jnp()
+
+    scale = _symmetric_scale(jnp.min(min_range), jnp.max(max_range))
+    q = jnp.clip(jnp.round(data / scale), -127, 127).astype(jnp.int8)
+    amax = scale * 127.0
+    return q, -amax, amax
+
+
+@register("_contrib_dequantize", differentiable=False,
+          aliases=("dequantize",))
+def dequantize(data, min_range, max_range, out_type="float32"):
+    jnp = _jnp()
+
+    scale = _symmetric_scale(jnp.min(min_range), jnp.max(max_range))
+    return data.astype(jnp.float32) * scale
+
+
+@register("_contrib_requantize", nout=3, differentiable=False,
+          aliases=("requantize",))
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator -> int8 (reference: requantize).  The int32 range
+    is min/max_range; the target int8 range comes from calibration (or the
+    actual data range when uncalibrated)."""
+    jnp = _jnp()
+
+    in_scale = _symmetric_scale(jnp.min(min_range), jnp.max(max_range),
+                                qmax=2147483647.0)
+    real = data.astype(jnp.float32) * in_scale
+    if min_calib_range is not None and max_calib_range is not None:
+        lo = jnp.float32(min_calib_range)
+        hi = jnp.float32(max_calib_range)
+    else:
+        lo = jnp.min(real)
+        hi = jnp.max(real)
+    out_scale = _symmetric_scale(lo, hi)
+    q = jnp.clip(jnp.round(real / out_scale), -127, 127).astype(jnp.int8)
+    amax = out_scale * 127.0
+    return q, -amax, amax
+
+
+@register("_contrib_quantized_fully_connected", differentiable=False,
+          aliases=("quantized_fully_connected",))
+def quantized_fully_connected(x, weight_q, wscale, *maybe_bias,
+                              act_min=0.0, act_max=0.0, num_hidden=None,
+                              no_bias=False, flatten=True):
+    """Fused int8 dense: quantize activation (calibrated range) -> int8
+    matmul with int32 accumulation on the MXU -> fp32 rescale (+ bias).
+
+    weight_q int8 (units, in); wscale fp32 per-output-channel (units,).
+    Reference: quantized_fully_connected-inl.h (per-tensor); per-channel
+    weight scales are the TPU upgrade (free in the XLA epilogue)."""
+    import jax
+    jnp = _jnp()
+
+    x2 = x.reshape(x.shape[0], -1) if flatten else x
+    ascale = _symmetric_scale(jnp.float32(act_min), jnp.float32(act_max))
+    xq = jnp.clip(jnp.round(x2 / ascale), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, weight_q, (((x2.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (ascale * wscale)
+    if maybe_bias and not no_bias:
+        y = y + maybe_bias[0]
+    return y
+
+
+@register("_contrib_quantized_conv", differentiable=False,
+          aliases=("quantized_conv",))
+def quantized_conv(x, weight_q, wscale, *maybe_bias, act_min=0.0,
+                   act_max=0.0, kernel=None, stride=None, pad=None,
+                   dilate=None, num_filter=None, num_group=1, no_bias=False,
+                   layout=None):
+    """Fused int8 NCHW convolution with int32 MXU accumulation.
+
+    weight_q int8 (O, I/g, kh, kw); wscale fp32 (O,)."""
+    import jax
+    from jax import lax
+    jnp = _jnp()
+
+    nd = x.ndim - 2
+    strides = tuple(stride) if stride else (1,) * nd
+    dil = tuple(dilate) if dilate else (1,) * nd
+    pads = [(p, p) for p in (tuple(pad) if pad else (0,) * nd)]
+    ascale = _symmetric_scale(jnp.float32(act_min), jnp.float32(act_max))
+    xq = jnp.clip(jnp.round(x / ascale), -127, 127).astype(jnp.int8)
+    dn = lax.conv_dimension_numbers(x.shape, weight_q.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    acc = lax.conv_general_dilated(
+        xq, weight_q, window_strides=strides, padding=pads,
+        rhs_dilation=dil, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    scale = (ascale * wscale).reshape((1, -1) + (1,) * nd)
+    y = acc.astype(jnp.float32) * scale
+    if maybe_bias and not no_bias:
+        y = y + maybe_bias[0].reshape((1, -1) + (1,) * nd)
+    return y
