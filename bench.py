@@ -203,6 +203,22 @@ def main():
             "mfu": round(llama_mfu, 4)}
     except Exception as e:
         extra["llama_proxy_train"] = {"error": repr(e)[:200]}
+    try:
+        # BASELINE binding metric: allreduce bandwidth (tools/bandwidth_
+        # measure.py ≙ reference tools/bandwidth/measure.py).  On one chip
+        # this exercises the on-device reduction path; the interconnect
+        # number needs a pod.
+        import os as _os
+        import sys as _sys
+
+        _sys.path.insert(0, _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)), "tools"))
+        import bandwidth_measure as _bwm
+
+        dt, bw = _bwm.measure_allreduce(64 << 20, iters=5)
+        extra["allreduce_bw_64mb"] = {"value": round(bw, 2), "unit": "GB/s"}
+    except Exception as e:
+        extra["allreduce_bw_64mb"] = {"error": repr(e)[:200]}
 
     print(json.dumps({
         "metric": "resnet50_train_throughput",

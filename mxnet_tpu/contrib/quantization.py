@@ -80,8 +80,12 @@ class _Calib:
         self.mode = mode
         self.minmax = {}
         self.samples = {}
+        self.last_fired = {}  # layer name -> last firing tick (exec order)
+        self._tick = 0
 
     def observe(self, key, arr):
+        self._tick += 1
+        self.last_fired[key] = self._tick
         a = _np.asarray(arr)
         lo, hi = float(a.min()), float(a.max())
         if key in self.minmax:
@@ -126,15 +130,30 @@ class _QuantizedLayer:
     def _setup(self, wq, wscale, bias, act_range, act):
         from .. import ndarray as nd
 
-        self._act_min, self._act_max = act_range
-        self._wq = nd.array(wq.astype("float32")).astype("int8")
-        self._wscale = nd.array(wscale)
-        self._bias = nd.array(bias) if bias is not None else None
+        # constants (not plain attributes) so collect_params/save_parameters
+        # serialize the quantized model — including the calibrated
+        # activation range — like any other Gluon net
+        self.weight_quantized = self.params.get_constant(
+            "weight_quantized", nd.array(wq.astype("float32")).astype("int8"))
+        self.weight_scale = self.params.get_constant(
+            "weight_scale", nd.array(wscale))
+        self.act_range = self.params.get_constant(
+            "act_range", nd.array(_np.asarray(act_range, dtype="float32")))
+        self._has_bias = bias is not None
+        if self._has_bias:
+            self.bias_fp32 = self.params.get_constant(
+                "bias_fp32", nd.array(bias))
+        for p in self._params.values():
+            p.initialize()
         self.act = act  # Block.__setattr__ registers it as a child
 
+    @property
+    def _wq(self):
+        return self.weight_quantized.data()
+
     def __repr__(self):
-        return (f"{type(self).__name__}(act_range=({self._act_min:.4g}, "
-                f"{self._act_max:.4g}))")
+        lo, hi = self.act_range.data().asnumpy()
+        return (f"{type(self).__name__}(act_range=({lo:.4g}, {hi:.4g}))")
 
 
 def _define_layers():
@@ -157,13 +176,13 @@ def _define_layers():
             return cls(wq, wscale, bias, act_range, act=orig.act,
                        flatten=orig._flatten, prefix=orig.prefix + "int8_")
 
-        def hybrid_forward(self, F, x):
-            args = [x, self._wq, self._wscale]
-            if self._bias is not None:
-                args.append(self._bias)
+        def hybrid_forward(self, F, x, weight_quantized, weight_scale,
+                           act_range, bias_fp32=None):
+            args = [x, weight_quantized, weight_scale, act_range]
+            if bias_fp32 is not None:
+                args.append(bias_fp32)
             y = F._contrib_quantized_fully_connected(
-                *args, act_min=self._act_min, act_max=self._act_max,
-                no_bias=self._bias is None, flatten=self._flatten)
+                *args, no_bias=bias_fp32 is None, flatten=self._flatten)
             return self.act(y) if self.act is not None else y
 
     class QuantizedConv2D(_QuantizedLayer, HybridBlock):
@@ -183,16 +202,17 @@ def _define_layers():
             return cls(wq, wscale, bias, act_range, orig._kwargs,
                        act=orig.act, prefix=orig.prefix + "int8_")
 
-        def hybrid_forward(self, F, x):
+        def hybrid_forward(self, F, x, weight_quantized, weight_scale,
+                           act_range, bias_fp32=None):
             kw = self._conv_kwargs
-            args = [x, self._wq, self._wscale]
-            if self._bias is not None:
-                args.append(self._bias)
+            args = [x, weight_quantized, weight_scale, act_range]
+            if bias_fp32 is not None:
+                args.append(bias_fp32)
             y = F._contrib_quantized_conv(
-                *args, act_min=self._act_min, act_max=self._act_max,
-                kernel=kw["kernel"], stride=kw["stride"], pad=kw["pad"],
-                dilate=kw["dilate"], num_filter=kw["num_filter"],
-                num_group=kw["num_group"], no_bias=self._bias is None)
+                *args, kernel=kw["kernel"], stride=kw["stride"],
+                pad=kw["pad"], dilate=kw["dilate"],
+                num_filter=kw["num_filter"], num_group=kw["num_group"],
+                no_bias=bias_fp32 is None)
             return self.act(y) if self.act is not None else y
 
     return QuantizedDense, QuantizedConv2D
@@ -202,12 +222,17 @@ QuantizedDense, QuantizedConv2D = _define_layers()
 
 
 def _target_layers(block, exclude):
-    """(parent, child_key, layer) for every quantizable descendant."""
+    """(parent, child_key, layer) for every quantizable descendant.
+
+    Conv2D with a non-NCHW layout stays fp32 (the int8 conv op lowers
+    NCHW dimension numbers only)."""
     from ..gluon import nn
 
     out = []
     for key, child in block._children.items():
-        if isinstance(child, nn.Dense) or type(child).__name__ == "Conv2D":
+        is_conv = type(child).__name__ == "Conv2D" and \
+            child._kwargs.get("layout") in (None, "NCHW")
+        if isinstance(child, nn.Dense) or is_conv:
             if child.name not in exclude:
                 out.append((block, key, child))
         else:
@@ -244,13 +269,11 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
     targets = _target_layers(net, set(exclude_layers))
     if not targets:
         raise MXNetError("no quantizable Dense/Conv2D layers found")
-    if quantize_mode == "smart" and len(targets) > 1:
-        targets = targets[:-1]  # the last quantizable layer feeds the loss
 
     # 1. calibration pass: observe each target layer's INPUT range.
     # Hybridized execution would bypass the child hooks (the cached jit
     # runs as one program), so calibration runs the eager path; the
-    # caller's hybridization state is restored afterwards.
+    # caller's hybridization state is restored afterwards (also on error).
     def _collect_active(b, out):
         if hasattr(b, "_active"):
             out.append((b, b._active))
@@ -262,22 +285,39 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
     net.hybridize(False)
     calib = _Calib(calib_mode)
     handles = []
-    for _, _, layer in targets:
-        handles.append(layer.register_forward_pre_hook(
-            (lambda lyr: lambda blk, inputs:
-             calib.observe(lyr.name, inputs[0].asnumpy()))(layer)))
-    with autograd.pause():
-        for i, batch in enumerate(calib_data):
-            if num_calib_batches is not None and i >= num_calib_batches:
-                break
-            x = batch if isinstance(batch, NDArray) else array(batch)
-            net(x)
-    for h in handles:
-        h.detach()
+    try:
+        for _, _, layer in targets:
+            handles.append(layer.register_forward_pre_hook(
+                (lambda lyr: lambda blk, inputs:
+                 calib.observe(lyr.name, inputs[0].asnumpy()))(layer)))
+        with autograd.pause():
+            for i, batch in enumerate(calib_data):
+                if num_calib_batches is not None and i >= num_calib_batches:
+                    break
+                x = batch if isinstance(batch, NDArray) else array(batch)
+                net(x)
+    except Exception:
+        for b, active in prev_active:
+            if active:
+                b.hybridize(True)
+        raise
+    finally:
+        for h in handles:
+            h.detach()
     missing = [l.name for _, _, l in targets if l.name not in calib.minmax]
     if missing:
+        for b, active in prev_active:
+            if active:
+                b.hybridize(True)
         raise MXNetError(f"calibration never reached layers {missing}; "
                          "pass calib_data that exercises the whole net")
+    if quantize_mode == "smart" and len(targets) > 1:
+        # keep the OUTPUT layer fp32 — decided by execution order (hook
+        # firing), not registration order, so custom blocks that register
+        # children out of call order still protect their logits layer
+        out_name = max((l.name for _, _, l in targets),
+                       key=lambda nm: calib.last_fired[nm])
+        targets = [t for t in targets if t[2].name != out_name]
 
     # 2. swap in quantized blocks
     for parent, key, layer in targets:

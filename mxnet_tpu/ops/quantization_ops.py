@@ -96,20 +96,21 @@ def requantize(data, min_range, max_range, min_calib_range=None,
 
 @register("_contrib_quantized_fully_connected", differentiable=False,
           aliases=("quantized_fully_connected",))
-def quantized_fully_connected(x, weight_q, wscale, *maybe_bias,
-                              act_min=0.0, act_max=0.0, num_hidden=None,
-                              no_bias=False, flatten=True):
+def quantized_fully_connected(x, weight_q, wscale, act_range, *maybe_bias,
+                              num_hidden=None, no_bias=False, flatten=True):
     """Fused int8 dense: quantize activation (calibrated range) -> int8
     matmul with int32 accumulation on the MXU -> fp32 rescale (+ bias).
 
-    weight_q int8 (units, in); wscale fp32 per-output-channel (units,).
+    weight_q int8 (units, in); wscale fp32 per-output-channel (units,);
+    act_range fp32 (2,) = calibrated [min, max] (an array input so
+    quantized models serialize it with their parameters).
     Reference: quantized_fully_connected-inl.h (per-tensor); per-channel
     weight scales are the TPU upgrade (free in the XLA epilogue)."""
     import jax
     jnp = _jnp()
 
     x2 = x.reshape(x.shape[0], -1) if flatten else x
-    ascale = _symmetric_scale(jnp.float32(act_min), jnp.float32(act_max))
+    ascale = _symmetric_scale(act_range[0], act_range[1])
     xq = jnp.clip(jnp.round(x2 / ascale), -127, 127).astype(jnp.int8)
     acc = jax.lax.dot_general(
         xq, weight_q, (((x2.ndim - 1,), (1,)), ((), ())),
@@ -122,22 +123,27 @@ def quantized_fully_connected(x, weight_q, wscale, *maybe_bias,
 
 @register("_contrib_quantized_conv", differentiable=False,
           aliases=("quantized_conv",))
-def quantized_conv(x, weight_q, wscale, *maybe_bias, act_min=0.0,
-                   act_max=0.0, kernel=None, stride=None, pad=None,
-                   dilate=None, num_filter=None, num_group=1, no_bias=False,
-                   layout=None):
+def quantized_conv(x, weight_q, wscale, act_range, *maybe_bias, kernel=None,
+                   stride=None, pad=None, dilate=None, num_filter=None,
+                   num_group=1, no_bias=False, layout=None):
     """Fused int8 NCHW convolution with int32 MXU accumulation.
 
-    weight_q int8 (O, I/g, kh, kw); wscale fp32 (O,)."""
+    weight_q int8 (O, I/g, kh, kw); wscale fp32 (O,); act_range fp32 (2,)
+    = calibrated [min, max]."""
     import jax
     from jax import lax
     jnp = _jnp()
 
+    if layout not in (None, "NCHW"):
+        from ..base import MXNetError
+
+        raise MXNetError(
+            f"quantized_conv lowers NCHW only, got layout={layout!r}")
     nd = x.ndim - 2
     strides = tuple(stride) if stride else (1,) * nd
     dil = tuple(dilate) if dilate else (1,) * nd
     pads = [(p, p) for p in (tuple(pad) if pad else (0,) * nd)]
-    ascale = _symmetric_scale(jnp.float32(act_min), jnp.float32(act_max))
+    ascale = _symmetric_scale(act_range[0], act_range[1])
     xq = jnp.clip(jnp.round(x / ascale), -127, 127).astype(jnp.int8)
     dn = lax.conv_dimension_numbers(x.shape, weight_q.shape,
                                     ("NCHW", "OIHW", "NCHW"))

@@ -1,0 +1,33 @@
+"""Bandwidth harness (reference: tools/bandwidth/measure.py — the
+BASELINE.md "KVStore allreduce BW" binding metric)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import bandwidth_measure as bwm  # noqa: E402
+
+
+def test_measure_allreduce_runs_and_reduces():
+    dt, bw = bwm.measure_allreduce(1 << 20, iters=3, warmup=1)
+    assert dt > 0 and bw > 0
+    assert np.isfinite(bw)
+
+
+def test_measure_pushpull_runs():
+    dt, bw = bwm.measure_pushpull(1 << 18, iters=3, warmup=1)
+    assert dt > 0 and bw > 0
+
+
+def test_cli_json_output(capsys):
+    rows = bwm.main(["--sizes-mb", "0.25,1", "--iters", "2", "--json"])
+    assert len(rows) == 2
+    assert all("allreduce_gbps" in r and "pushpull_gbps" in r for r in rows)
+    out = capsys.readouterr().out.strip().splitlines()
+    import json
+
+    parsed = [json.loads(l) for l in out]
+    assert parsed[0]["size_mb"] == 0.25

@@ -36,7 +36,7 @@ def test_quantized_fully_connected_close_to_fp32():
     wq, wscale = q._quantize_weight(w)
     y = nd.contrib.quantized_fully_connected(
         nd.array(x), nd.array(wq.astype("f")).astype("int8"),
-        nd.array(wscale), nd.array(b), act_min=-1.0, act_max=1.0)
+        nd.array(wscale), nd.array(np.array([-1.0, 1.0], "f")), nd.array(b))
     ref = x @ w.T + b
     err = np.abs(y.asnumpy() - ref).max()
     assert err < 0.05, err
@@ -145,6 +145,77 @@ def test_kl_threshold_reasonable():
     u = R.uniform(-1, 1, 100000)
     tu = q.optimal_threshold_kl(u)
     assert tu > 0.7
+
+
+def test_smart_mode_protects_output_layer_by_exec_order():
+    """The layer kept fp32 must be the one that EXECUTES last, even when
+    registered first (custom blocks register children out of call order)."""
+    class _M(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.out = gluon.nn.Dense(10, in_units=16, prefix="out_")
+                self.hidden = gluon.nn.Dense(16, in_units=8,
+                                             prefix="hidden_")
+
+        def hybrid_forward(self, F, x):
+            return self.out(self.hidden(x))
+
+    R = np.random.RandomState(4)
+    net = _M()
+    net.initialize()
+    x = R.uniform(-1, 1, (8, 8)).astype("f")
+    net(nd.array(x))
+    q.quantize_net(net, calib_data=[x])
+    assert isinstance(net.hidden, q.QuantizedDense)
+    assert type(net.out).__name__ == "Dense", "logits layer must stay fp32"
+
+
+def test_quantize_net_save_load_roundtrip(tmp_path):
+    """A quantized net serializes like any Gluon net (int8 weights and
+    scales are Constants in collect_params)."""
+    R = np.random.RandomState(5)
+    x = R.uniform(-1, 1, (8, 6)).astype("f")
+
+    def build():
+        n = gluon.nn.HybridSequential(prefix="qnet_")
+        with n.name_scope():
+            n.add(gluon.nn.Dense(12, activation="relu", in_units=6,
+                                 prefix="d0_"),
+                  gluon.nn.Dense(4, in_units=12, prefix="d1_"))
+        return n
+
+    net = build()
+    net.initialize()
+    net(nd.array(x))
+    q.quantize_net(net, calib_data=[x])
+    ref = net(nd.array(x)).asnumpy()
+    f = str(tmp_path / "q.params")
+    net.save_parameters(f)
+
+    net2 = build()
+    net2.initialize()
+    net2(nd.array(x))
+    # different weights AND different calibration than net: everything the
+    # forward depends on must come from the loaded file
+    q.quantize_net(net2, calib_data=[x * 0.5])
+    net2.load_parameters(f)
+    out = net2(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_quantize_net_failed_calibration_restores_state():
+    """A bad calib batch must not leave hooks attached or the net eager."""
+    R = np.random.RandomState(6)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, in_units=3))
+    net.initialize()
+    net.hybridize()
+    net(nd.ones((2, 3)))
+    with pytest.raises(Exception):
+        q.quantize_net(net, calib_data=[np.ones((2, 999), "f")])
+    assert net._active, "hybridization must be restored after failure"
+    assert not net[0]._forward_pre_hooks, "hooks must be detached"
 
 
 def test_quantize_net_requires_calib_data():
