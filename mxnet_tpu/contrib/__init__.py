@@ -1,3 +1,4 @@
 """Contrib namespace (reference: python/mxnet/contrib/ — SURVEY.md §3.5)."""
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

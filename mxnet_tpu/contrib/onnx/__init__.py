@@ -1,0 +1,8 @@
+"""ONNX interop (reference: ``python/mxnet/contrib/onnx/`` — SURVEY.md
+§3.5 contrib row): ``export_model`` (mx2onnx) and ``import_model`` /
+``import_to_gluon`` (onnx2mx), self-contained over a minimal protobuf
+wire codec (this environment has no onnx pip package)."""
+from .mx2onnx import export_model
+from .onnx2mx import import_model, import_to_gluon
+
+__all__ = ["export_model", "import_model", "import_to_gluon"]

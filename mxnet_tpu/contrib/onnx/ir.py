@@ -1,0 +1,176 @@
+"""ONNX IR subset: schemas (field numbers per the public onnx.proto3) +
+builder/reader helpers over the wire codec."""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import wire
+
+# -- TensorProto.DataType (public enum values) ------------------------------
+DT = {"float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+      "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+      "uint32": 12, "uint64": 13, "bfloat16": 16}
+DT_INV = {v: k for k, v in DT.items()}
+
+# -- message schemas: {field: (name, kind, repeated)} -----------------------
+TENSOR = {
+    1: ("dims", "int", True),
+    2: ("data_type", "int", False),
+    4: ("float_data", "float", True),
+    5: ("int32_data", "int", True),
+    7: ("int64_data", "int", True),
+    8: ("name", "string", False),
+    9: ("raw_data", "bytes", False),
+    10: ("double_data", "double", True),
+}
+ATTRIBUTE = {
+    1: ("name", "string", False),
+    2: ("f", "float", False),
+    3: ("i", "int", False),
+    4: ("s", "bytes", False),
+    5: ("t", TENSOR, False),
+    7: ("floats", "float", True),
+    8: ("ints", "int", True),
+    9: ("strings", "bytes", True),
+    20: ("type", "int", False),
+}
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR = 1, 2, 3, 4
+AT_FLOATS, AT_INTS, AT_STRINGS = 6, 7, 8
+
+DIMENSION = {1: ("dim_value", "int", False), 2: ("dim_param", "string", False)}
+SHAPE = {1: ("dim", DIMENSION, True)}
+TENSOR_TYPE = {1: ("elem_type", "int", False), 2: ("shape", SHAPE, False)}
+TYPE = {1: ("tensor_type", TENSOR_TYPE, False)}
+VALUE_INFO = {1: ("name", "string", False), 2: ("type", TYPE, False)}
+NODE = {
+    1: ("input", "string", True),
+    2: ("output", "string", True),
+    3: ("name", "string", False),
+    4: ("op_type", "string", False),
+    5: ("attribute", ATTRIBUTE, True),
+    7: ("domain", "string", False),
+}
+GRAPH = {
+    1: ("node", NODE, True),
+    2: ("name", "string", False),
+    5: ("initializer", TENSOR, True),
+    11: ("input", VALUE_INFO, True),
+    12: ("output", VALUE_INFO, True),
+    13: ("value_info", VALUE_INFO, True),
+}
+OPSET = {1: ("domain", "string", False), 2: ("version", "int", False)}
+MODEL = {
+    1: ("ir_version", "int", False),
+    2: ("producer_name", "string", False),
+    3: ("producer_version", "string", False),
+    5: ("model_version", "int", False),
+    7: ("graph", GRAPH, False),
+    8: ("opset_import", OPSET, True),
+}
+
+OPSET_VERSION = 13
+IR_VERSION = 8
+
+
+# -- builders ---------------------------------------------------------------
+def make_tensor(name, arr):
+    arr = _np.ascontiguousarray(arr)
+    dt = DT.get(str(arr.dtype))
+    if dt is None:
+        raise ValueError(f"dtype {arr.dtype} has no ONNX mapping")
+    return {"name": name, "dims": list(arr.shape), "data_type": dt,
+            "raw_data": arr.tobytes()}
+
+
+def tensor_to_numpy(t):
+    import ml_dtypes  # bundled with jax; provides the bfloat16 numpy dtype
+
+    name = DT_INV[t["data_type"]]
+    dtype = _np.dtype(ml_dtypes.bfloat16) if name == "bfloat16" \
+        else _np.dtype(name)
+    dims = t.get("dims", [])
+    if "raw_data" in t and t["raw_data"]:
+        return _np.frombuffer(t["raw_data"], dtype=dtype).reshape(dims).copy()
+    if name in ("float16", "bfloat16") and t.get("int32_data"):
+        # per onnx.proto, 16-bit floats in int32_data carry uint16 BIT
+        # PATTERNS — reinterpret, never value-cast
+        bits = _np.asarray(t["int32_data"], dtype="int32").astype("uint16")
+        return bits.view(dtype).reshape(dims)
+    for field, cast in (("float_data", "float32"), ("int64_data", "int64"),
+                        ("int32_data", "int32"), ("double_data", "float64")):
+        if t.get(field):
+            return _np.asarray(t[field], dtype=cast).astype(dtype).reshape(dims)
+    return _np.zeros(dims, dtype=dtype)
+
+
+def make_attr(name, value):
+    if isinstance(value, bool):
+        return {"name": name, "type": AT_INT, "i": int(value)}
+    if isinstance(value, int):
+        return {"name": name, "type": AT_INT, "i": value}
+    if isinstance(value, float):
+        return {"name": name, "type": AT_FLOAT, "f": value}
+    if isinstance(value, str):
+        return {"name": name, "type": AT_STRING, "s": value.encode()}
+    if isinstance(value, _np.ndarray):
+        return {"name": name, "type": AT_TENSOR, "t": make_tensor(name, value)}
+    if isinstance(value, (list, tuple)):
+        if all(isinstance(v, (int, _np.integer)) for v in value):
+            return {"name": name, "type": AT_INTS,
+                    "ints": [int(v) for v in value]}
+        return {"name": name, "type": AT_FLOATS,
+                "floats": [float(v) for v in value]}
+    raise ValueError(f"attr {name}: unsupported value {value!r}")
+
+
+def attr_value(a):
+    t = a.get("type")
+    if t == AT_INT:
+        return a.get("i", 0)
+    if t == AT_FLOAT:
+        return a.get("f", 0.0)
+    if t == AT_STRING:
+        return a.get("s", b"").decode()
+    if t == AT_INTS:
+        return list(a.get("ints", []))
+    if t == AT_FLOATS:
+        return list(a.get("floats", []))
+    if t == AT_TENSOR:
+        return tensor_to_numpy(a["t"])
+    return None
+
+
+def attrs_of(node):
+    return {a["name"]: attr_value(a) for a in node.get("attribute", [])}
+
+
+def make_node(op_type, inputs, outputs, name=None, **attrs):
+    n = {"op_type": op_type, "input": list(inputs), "output": list(outputs),
+         "name": name or outputs[0]}
+    if attrs:
+        n["attribute"] = [make_attr(k, v) for k, v in attrs.items()
+                          if v is not None]
+    return n
+
+
+def make_value_info(name, shape, dtype="float32"):
+    return {"name": name, "type": {"tensor_type": {
+        "elem_type": DT[str(dtype)],
+        "shape": {"dim": [
+            {"dim_value": int(d)} if d else {"dim_param": "N"}
+            for d in shape]}}}}
+
+
+def make_model(graph, producer="mxnet_tpu"):
+    return {"ir_version": IR_VERSION, "producer_name": producer,
+            "producer_version": "0.1", "model_version": 1, "graph": graph,
+            "opset_import": [{"domain": "", "version": OPSET_VERSION}]}
+
+
+def serialize_model(model):
+    return wire.encode(model, MODEL)
+
+
+def parse_model(data):
+    return wire.decode(data, MODEL)

@@ -1,0 +1,247 @@
+"""Symbol/Gluon -> ONNX export.
+
+Reference: ``python/mxnet/contrib/onnx/mx2onnx/export_model.py`` +
+``_op_translations.py`` (SURVEY.md §3.5 contrib onnx row): walk the symbol
+graph, translate node-by-node into ONNX ops, params become initializers.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import ir
+
+__all__ = ["export_model"]
+
+
+def _attr(attrs, name, default=None):
+    v = attrs.get(name, default)
+    if isinstance(v, str):
+        try:
+            v = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            pass
+    return v
+
+
+def _tup(v, n=2):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _bool(v):
+    return str(v).lower() in ("1", "true")
+
+
+# -- per-op translators: (node, in_names, out_name, attrs, ctxobj) -> [nodes]
+def _conv(n, ins, out, a, ctx):
+    kernel = _tup(_attr(a, "kernel"))
+    return [ir.make_node(
+        "Conv", ins, [out], name=n.name, kernel_shape=list(kernel),
+        strides=list(_tup(_attr(a, "stride"), len(kernel))),
+        dilations=list(_tup(_attr(a, "dilate"), len(kernel))),
+        pads=list(_tup(_attr(a, "pad", 0), len(kernel))) * 2,
+        group=int(_attr(a, "num_group", 1)))]
+
+
+def _fc(n, ins, out, a, ctx):
+    nodes = []
+    data = ins[0]
+    if _bool(_attr(a, "flatten", True)):
+        flat = f"{n.name}_flat"
+        nodes.append(ir.make_node("Flatten", [data], [flat],
+                                  name=flat, axis=1))
+        gemm_in = [flat, ins[1]] + (ins[2:3] if len(ins) > 2 else [])
+        nodes.append(ir.make_node("Gemm", gemm_in, [out], name=n.name,
+                                  alpha=1.0, beta=1.0, transA=0, transB=1))
+        return nodes
+    # flatten=False keeps leading dims (transformer projections): Gemm
+    # requires 2-D A, so emit Transpose(W) + MatMul (+ Add) instead
+    wt = f"{n.name}_wT"
+    nodes.append(ir.make_node("Transpose", [ins[1]], [wt], name=wt,
+                              perm=[1, 0]))
+    if len(ins) > 2:
+        mm = f"{n.name}_mm"
+        nodes.append(ir.make_node("MatMul", [data, wt], [mm], name=mm))
+        nodes.append(ir.make_node("Add", [mm, ins[2]], [out], name=n.name))
+    else:
+        nodes.append(ir.make_node("MatMul", [data, wt], [out], name=n.name))
+    return nodes
+
+
+def _bn(n, ins, out, a, ctx):
+    return [ir.make_node(
+        "BatchNormalization", ins, [out], name=n.name,
+        epsilon=float(_attr(a, "eps", 1e-5)),
+        momentum=float(_attr(a, "momentum", 0.9)))]
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softsign": "Softsign", "softrelu": "Softplus"}
+
+
+def _activation(n, ins, out, a, ctx):
+    act = _attr(a, "act_type", "relu")
+    if act not in _ACT:
+        raise MXNetError(f"Activation {act!r} has no ONNX mapping")
+    return [ir.make_node(_ACT[act], ins, [out], name=n.name)]
+
+
+def _pooling(n, ins, out, a, ctx):
+    ptype = _attr(a, "pool_type", "max")
+    if _bool(_attr(a, "global_pool", False)):
+        op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+        return [ir.make_node(op, ins, [out], name=n.name)]
+    kernel = _tup(_attr(a, "kernel"))
+    op = "MaxPool" if ptype == "max" else "AveragePool"
+    kw = dict(kernel_shape=list(kernel),
+              strides=list(_tup(_attr(a, "stride"), len(kernel))),
+              pads=list(_tup(_attr(a, "pad", 0), len(kernel))) * 2)
+    if op == "AveragePool":
+        kw["count_include_pad"] = int(
+            _bool(_attr(a, "count_include_pad", True)))
+    return [ir.make_node(op, ins, [out], name=n.name, **kw)]
+
+
+def _simple(onnx_op, **extra):
+    def conv(n, ins, out, a, ctx):
+        kw = {}
+        for onnx_name, (mx_name, default, cast) in extra.items():
+            v = _attr(a, mx_name, default)
+            kw[onnx_name] = cast(v) if v is not None else None
+        return [ir.make_node(onnx_op, ins, [out], name=n.name, **kw)]
+
+    return conv
+
+
+def _reshape(n, ins, out, a, ctx):
+    shape = _np.asarray(_tup(_attr(a, "shape"), 0), dtype="int64")
+    sname = f"{n.name}_shape"
+    ctx.initializers.append(ir.make_tensor(sname, shape))
+    return [ir.make_node("Reshape", [ins[0], sname], [out], name=n.name)]
+
+
+def _dropout(n, ins, out, a, ctx):
+    # inference export: dropout is identity
+    return [ir.make_node("Identity", ins[:1], [out], name=n.name)]
+
+
+def _leaky(n, ins, out, a, ctx):
+    act = _attr(a, "act_type", "leaky")
+    if act == "leaky":
+        return [ir.make_node("LeakyRelu", ins, [out], name=n.name,
+                             alpha=float(_attr(a, "slope", 0.25)))]
+    if act == "elu":
+        return [ir.make_node("Elu", ins, [out], name=n.name,
+                             alpha=float(_attr(a, "slope", 0.25)))]
+    raise MXNetError(f"LeakyReLU act_type {act!r} has no ONNX mapping")
+
+
+_TRANSLATORS = {
+    "Convolution": _conv,
+    "FullyConnected": _fc,
+    "BatchNorm": _bn,
+    "Activation": _activation,
+    "Pooling": _pooling,
+    "Flatten": _simple("Flatten", axis=("axis", 1, int)),
+    "flatten": _simple("Flatten", axis=("axis", 1, int)),
+    "relu": _simple("Relu"),
+    "sigmoid": _simple("Sigmoid"),
+    "tanh": _simple("Tanh"),
+    "softsign": _simple("Softsign"),
+    "elemwise_add": _simple("Add"),
+    "broadcast_add": _simple("Add"),
+    "elemwise_sub": _simple("Sub"),
+    "broadcast_sub": _simple("Sub"),
+    "elemwise_mul": _simple("Mul"),
+    "broadcast_mul": _simple("Mul"),
+    "elemwise_div": _simple("Div"),
+    "broadcast_div": _simple("Div"),
+    "softmax": _simple("Softmax", axis=("axis", -1, int)),
+    "log_softmax": _simple("LogSoftmax", axis=("axis", -1, int)),
+    "concat": _simple("Concat", axis=("dim", 1, int)),
+    "Concat": _simple("Concat", axis=("dim", 1, int)),
+    "transpose": _simple("Transpose", perm=("axes", None, list)),
+    "Dropout": _dropout,
+    "LeakyReLU": _leaky,
+    "Reshape": _reshape,
+    "reshape": _reshape,
+    "dot": _simple("MatMul"),
+}
+
+
+class _ExportCtx:
+    def __init__(self):
+        self.initializers = []
+
+
+def export_model(sym, params=None, input_shape=None, input_dtype="float32",
+                 onnx_file_path="model.onnx", example_input=None):
+    """Export a Symbol (+ params dict) or a HybridBlock to an ONNX file.
+
+    Returns the file path (reference: onnx_mxnet.export_model)."""
+    from ...symbol.symbol import Symbol, _topo
+
+    arg_params = dict(params or {})
+    if not isinstance(sym, Symbol):  # HybridBlock path
+        block = sym
+        if example_input is None:
+            if input_shape is None:
+                raise MXNetError("export_model needs input_shape or "
+                                 "example_input for a HybridBlock")
+            from ... import ndarray as nd
+
+            example_input = nd.zeros(input_shape, dtype=input_dtype)
+        sym, args, auxs = block._trace_to_symbol(example_input)
+        arg_params = {}
+        arg_params.update(args)
+        arg_params.update(auxs)
+        if isinstance(sym, (list, tuple)):
+            sym = sym[0]
+
+    nodes = _topo(sym._heads)
+    ctx = _ExportCtx()
+    out_name = {}
+    graph_nodes = []
+    graph_inputs = []
+
+    def tname(node, idx=0):
+        if node.op is None:
+            return node.name
+        return node.name if node.nout == 1 and idx == 0 else \
+            f"{node.name}_out{idx}"
+
+    for n in nodes:
+        if n.op is None:
+            if n.is_const:
+                ctx.initializers.append(ir.make_tensor(n.name, n.value))
+            elif n.name in arg_params:
+                v = arg_params[n.name]
+                v = v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
+                ctx.initializers.append(ir.make_tensor(n.name, v))
+            else:
+                shape = input_shape if input_shape is not None else ()
+                graph_inputs.append(ir.make_value_info(
+                    n.name, shape, input_dtype))
+            continue
+        tr = _TRANSLATORS.get(n.op)
+        if tr is None:
+            raise MXNetError(
+                f"op {n.op!r} has no ONNX translation (node {n.name!r})")
+        ins = [tname(inp, idx) for inp, idx in n.inputs]
+        graph_nodes.extend(tr(n, ins, tname(n), n.attrs, ctx))
+
+    outputs = [ir.make_value_info(tname(node, idx), (), input_dtype)
+               for node, idx in sym._heads]
+    graph = {"name": "mxnet_tpu_model", "node": graph_nodes,
+             "initializer": ctx.initializers, "input": graph_inputs,
+             "output": outputs}
+    data = ir.serialize_model(ir.make_model(graph))
+    with open(onnx_file_path, "wb") as f:
+        f.write(data)
+    return onnx_file_path

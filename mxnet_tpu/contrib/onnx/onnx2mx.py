@@ -1,0 +1,266 @@
+"""ONNX -> Symbol import.
+
+Reference: ``python/mxnet/contrib/onnx/onnx2mx/import_model.py`` +
+``_import_helper.py`` op map (SURVEY.md §3.5 contrib onnx row): returns
+``(sym, arg_params, aux_params)`` ready for Module/SymbolBlock.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import ir
+
+__all__ = ["import_model", "import_to_gluon"]
+
+
+def _pool_attrs(a):
+    kernel = tuple(a.get("kernel_shape", (1, 1)))
+    pads = a.get("pads")
+    pad = tuple(pads[:len(kernel)]) if pads else (0,) * len(kernel)
+    return kernel, tuple(a.get("strides", (1,) * len(kernel))), pad
+
+
+class _Importer:
+    def __init__(self):
+        import mxnet_tpu as mx
+
+        self.sym = mx.sym
+        self.nd = mx.nd
+        self.tensors = {}      # onnx name -> Symbol
+        self.arg_params = {}
+        self.aux_params = {}
+        self.initializer_data = {}
+        self.unproduced = set()  # declared-but-unsupported node outputs
+
+    def var(self, name):
+        if name in self.unproduced:
+            raise MXNetError(
+                f"ONNX tensor {name!r} is a secondary node output this "
+                "importer does not produce (e.g. Dropout mask / BN "
+                "training stats) but the graph consumes it")
+        if name not in self.tensors:
+            self.tensors[name] = self.sym.var(name)
+        return self.tensors[name]
+
+    # -- op handlers -------------------------------------------------------
+    def _conv(self, node, a, name):
+        ins = node["input"]
+        kernel, stride, pad = _pool_attrs(a)
+        w = self.initializer_data.get(ins[1])
+        num_filter = int(w.shape[0]) if w is not None else 0
+        return self.sym.Convolution(
+            *[self.var(i) for i in ins], kernel=kernel, stride=stride,
+            pad=pad, dilate=tuple(a.get("dilations", (1,) * len(kernel))),
+            num_filter=num_filter, num_group=int(a.get("group", 1)),
+            no_bias=len(ins) < 3, name=name)
+
+    def _gemm(self, node, a, name):
+        ins = node["input"]
+        if a.get("transA"):
+            raise MXNetError("Gemm with transA has no FC mapping")
+        w = self.initializer_data.get(ins[1])
+        if w is None:
+            raise MXNetError("Gemm needs a constant B (weight) input")
+        if not a.get("transB", 0):
+            # FC wants (out, in): transpose the initializer once at import
+            w = _np.ascontiguousarray(w.T)
+        alpha = float(a.get("alpha", 1.0))
+        if alpha != 1.0:  # fold into the weight
+            w = w * alpha
+        if w is not self.initializer_data.get(ins[1]):
+            self.initializer_data[ins[1]] = w
+            self.arg_params[ins[1]] = self.nd.array(w)
+        beta = float(a.get("beta", 1.0))
+        if len(ins) > 2 and beta != 1.0:
+            b = self.initializer_data.get(ins[2])
+            if b is None:
+                raise MXNetError("Gemm with beta != 1 needs a constant C")
+            b = b * beta
+            self.initializer_data[ins[2]] = b
+            self.arg_params[ins[2]] = self.nd.array(b)
+        return self.sym.FullyConnected(
+            *[self.var(i) for i in ins], num_hidden=int(w.shape[0]),
+            no_bias=len(ins) < 3, flatten=False, name=name)
+
+    def _bn(self, node, a, name):
+        ins = node["input"]
+        # stats are aux states; rename when the source name lacks the
+        # suffix the aux-classification convention keys on
+        data, scale, bias, mean, var = ins
+        for old, suffix in ((mean, "running_mean"), (var, "running_var")):
+            if old in self.arg_params:
+                if old.endswith(suffix):
+                    self.aux_params[old] = self.arg_params.pop(old)
+                else:
+                    new = f"{name}_{suffix}"
+                    self.aux_params[new] = self.arg_params.pop(old)
+                    self.tensors[new] = self.sym.var(new)
+                    self.tensors[old] = self.tensors[new]
+        return self.sym.BatchNorm(
+            self.var(data), self.var(scale), self.var(bias),
+            self.var(mean), self.var(var),
+            eps=float(a.get("epsilon", 1e-5)),
+            momentum=float(a.get("momentum", 0.9)), fix_gamma=False,
+            use_global_stats=True, name=name)
+
+    def _pool(self, op):
+        def h(self_, node, a, name):
+            ins = node["input"]
+            if op.startswith("Global"):
+                return self_.sym.Pooling(
+                    self_.var(ins[0]), global_pool=True,
+                    pool_type="max" if "Max" in op else "avg", name=name)
+            kernel, stride, pad = _pool_attrs(a)
+            return self_.sym.Pooling(
+                self_.var(ins[0]), kernel=kernel, stride=stride, pad=pad,
+                pool_type="max" if op == "MaxPool" else "avg",
+                count_include_pad=bool(a.get("count_include_pad", 1)),
+                name=name)
+
+        return h
+
+    def _reshape(self, node, a, name):
+        ins = node["input"]
+        shape = self.initializer_data.get(ins[1])
+        if shape is None:
+            raise MXNetError("Reshape needs a constant shape input")
+        self.arg_params.pop(ins[1], None)
+        return self.sym.reshape(self.var(ins[0]),
+                                shape=tuple(int(s) for s in shape), name=name)
+
+    def _unary(self, mx_op, **fixed):
+        def h(self_, node, a, name):
+            return getattr(self_.sym, mx_op)(
+                self_.var(node["input"][0]), name=name, **fixed)
+
+        return h
+
+    def _binary(self, mx_op):
+        def h(self_, node, a, name):
+            i = node["input"]
+            return getattr(self_.sym, mx_op)(
+                self_.var(i[0]), self_.var(i[1]), name=name)
+
+        return h
+
+    def _axis_op(self, mx_op, attr="axis", default=-1, mx_attr="axis"):
+        def h(self_, node, a, name):
+            return getattr(self_.sym, mx_op)(
+                self_.var(node["input"][0]), name=name,
+                **{mx_attr: int(a.get(attr, default))})
+
+        return h
+
+    def convert(self, node):
+        op = node["op_type"]
+        a = ir.attrs_of(node)
+        name = node.get("name") or node["output"][0]
+        handlers = {
+            "Conv": _Importer._conv,
+            "Gemm": _Importer._gemm,
+            "BatchNormalization": _Importer._bn,
+            "Reshape": _Importer._reshape,
+            "MaxPool": self._pool("MaxPool"),
+            "AveragePool": self._pool("AveragePool"),
+            "GlobalMaxPool": self._pool("GlobalMaxPool"),
+            "GlobalAveragePool": self._pool("GlobalAveragePool"),
+            "Relu": self._unary("relu"),
+            "Sigmoid": self._unary("sigmoid"),
+            "Tanh": self._unary("tanh"),
+            "Softsign": self._unary("softsign"),
+            "Identity": None,
+            "Flatten": self._unary("Flatten"),
+            "Add": self._binary("broadcast_add"),
+            "Sub": self._binary("broadcast_sub"),
+            "Mul": self._binary("broadcast_mul"),
+            "Div": self._binary("broadcast_div"),
+            "MatMul": self._binary("dot"),
+            "Softmax": self._axis_op("softmax"),
+            "LogSoftmax": self._axis_op("log_softmax"),
+            "Transpose": None,  # special below
+            "Concat": None,
+            "LeakyRelu": None,
+            "Elu": None,
+            "Dropout": None,
+        }
+        if op == "Transpose":
+            out = self.sym.transpose(self.var(node["input"][0]),
+                                     axes=tuple(a.get("perm", ())) or None,
+                                     name=name)
+        elif op == "Concat":
+            out = self.sym.concat(*[self.var(i) for i in node["input"]],
+                                  dim=int(a.get("axis", 1)), name=name)
+        elif op == "LeakyRelu":
+            out = self.sym.LeakyReLU(self.var(node["input"][0]),
+                                     act_type="leaky",
+                                     slope=float(a.get("alpha", 0.01)),
+                                     name=name)
+        elif op == "Elu":
+            out = self.sym.LeakyReLU(self.var(node["input"][0]),
+                                     act_type="elu",
+                                     slope=float(a.get("alpha", 1.0)),
+                                     name=name)
+        elif op == "Dropout":
+            out = self.var(node["input"][0])
+        elif op == "Identity":
+            out = self.var(node["input"][0])
+        elif op in handlers and handlers[op] is not None:
+            out = handlers[op](self, node, a, name)
+        else:
+            raise MXNetError(f"ONNX op {op!r} has no import mapping")
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        main = node["output"]
+        for o_name, o_sym in zip(main, outs):
+            self.tensors[o_name] = o_sym
+        # secondary outputs we did not produce (Dropout mask, BN training
+        # stats): legal to DECLARE but an error to consume — record them
+        # so var() fails loudly instead of silently making a free input
+        for o_name in main[len(outs):]:
+            self.unproduced.add(o_name)
+        return out
+
+
+def import_model(model_file):
+    """Parse an .onnx file -> (sym, arg_params, aux_params)."""
+    with open(model_file, "rb") as f:
+        data = f.read()
+    model = ir.parse_model(data)
+    graph = model.get("graph")
+    if graph is None:
+        raise MXNetError(f"{model_file}: no graph in ONNX model")
+
+    imp = _Importer()
+    for t in graph.get("initializer", []):
+        arr = ir.tensor_to_numpy(t)
+        imp.initializer_data[t["name"]] = arr
+        imp.arg_params[t["name"]] = imp.nd.array(arr)
+    for node in graph.get("node", []):
+        imp.convert(node)
+    outs = []
+    for vi in graph.get("output", []):
+        name = vi["name"]
+        if name not in imp.tensors:
+            raise MXNetError(f"ONNX output {name!r} was never produced")
+        outs.append(imp.tensors[name])
+    sym = outs[0] if len(outs) == 1 else imp.sym.Group(outs)
+    return sym, imp.arg_params, imp.aux_params
+
+
+def import_to_gluon(model_file, ctx=None):
+    """Parse an .onnx file into a SymbolBlock (reference:
+    onnx_mxnet.import_to_gluon)."""
+    import mxnet_tpu as mx
+    from ...gluon.block import SymbolBlock
+
+    sym, arg_params, aux_params = import_model(model_file)
+    graph = ir.parse_model(open(model_file, "rb").read())["graph"]
+    # older exporters list initializers in graph.input too
+    # (keep_initializers_as_inputs): only initializer-free names are
+    # runtime inputs
+    init_names = {t["name"] for t in graph.get("initializer", [])}
+    inputs = [mx.sym.var(vi["name"]) for vi in graph.get("input", [])
+              if vi["name"] not in init_names]
+    params = dict(arg_params)
+    params.update(aux_params)
+    return SymbolBlock(sym, inputs, params=params)

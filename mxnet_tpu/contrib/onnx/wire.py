@@ -1,0 +1,163 @@
+"""Minimal protobuf wire-format codec (no protobuf/onnx dependency).
+
+Reference role: the serialization layer under
+``python/mxnet/contrib/onnx`` (which uses the onnx pip package; this
+environment has none, so the ONNX IR subset is encoded/decoded directly —
+field numbers follow the public onnx.proto3 spec, so files interoperate
+with standard ONNX tooling).
+
+Schema model: a message schema is ``{field_number: (name, kind, repeated)}``
+with kind in {'int','float','double','bytes','string',sub-schema-dict}.
+Messages are plain dicts; repeated fields are lists.
+"""
+from __future__ import annotations
+
+import struct
+
+__all__ = ["encode", "decode"]
+
+
+def _enc_varint(v, out):
+    if v < 0:
+        v &= (1 << 64) - 1  # two's-complement 64-bit like protobuf
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _dec_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    if result >= 1 << 63:  # negative int64
+        result -= 1 << 64
+    return result, pos
+
+
+def _enc_field(num, kind, value, out):
+    if isinstance(kind, dict):  # nested message
+        payload = encode(value, kind)
+        _enc_varint((num << 3) | 2, out)
+        _enc_varint(len(payload), out)
+        out.extend(payload)
+    elif kind == "int":
+        _enc_varint((num << 3) | 0, out)
+        _enc_varint(int(value), out)
+    elif kind == "float":
+        _enc_varint((num << 3) | 5, out)
+        out.extend(struct.pack("<f", float(value)))
+    elif kind == "double":
+        _enc_varint((num << 3) | 1, out)
+        out.extend(struct.pack("<d", float(value)))
+    elif kind in ("bytes", "string"):
+        data = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        _enc_varint((num << 3) | 2, out)
+        _enc_varint(len(data), out)
+        out.extend(data)
+    else:  # pragma: no cover
+        raise ValueError(f"unknown kind {kind!r}")
+
+
+def encode(msg, schema):
+    """dict -> wire bytes (fields emitted in field-number order)."""
+    out = bytearray()
+    by_name = {name: (num, kind, rep)
+               for num, (name, kind, rep) in schema.items()}
+    for num in sorted(schema):
+        name, kind, repeated = schema[num]
+        if name not in msg or msg[name] is None:
+            continue
+        vals = msg[name] if repeated else [msg[name]]
+        if repeated and kind in ("int", "float", "double") and vals:
+            # packed encoding (proto3 default for repeated scalars)
+            payload = bytearray()
+            for v in vals:
+                if kind == "int":
+                    _enc_varint(int(v), payload)
+                elif kind == "float":
+                    payload.extend(struct.pack("<f", float(v)))
+                else:
+                    payload.extend(struct.pack("<d", float(v)))
+            _enc_varint((num << 3) | 2, out)
+            _enc_varint(len(payload), out)
+            out.extend(payload)
+            continue
+        for v in vals:
+            _enc_field(num, kind, v, out)
+    return bytes(out)
+
+
+def decode(buf, schema, pos=0, end=None):
+    """wire bytes -> dict (repeated fields become lists; missing = absent).
+
+    Unknown fields are skipped, packed and unpacked repeated scalars both
+    accepted — enough to read files produced by the official onnx lib."""
+    end = len(buf) if end is None else end
+    msg = {}
+
+    def put(name, repeated, value):
+        if repeated:
+            msg.setdefault(name, []).append(value)
+        else:
+            msg[name] = value
+
+    while pos < end:
+        key, pos = _dec_varint(buf, pos)
+        num, wt = key >> 3, key & 7
+        field = schema.get(num)
+        if wt == 0:
+            v, pos = _dec_varint(buf, pos)
+            if field:
+                name, kind, rep = field
+                put(name, rep, v)
+        elif wt == 5:
+            v = struct.unpack_from("<f", buf, pos)[0]
+            pos += 4
+            if field:
+                name, kind, rep = field
+                put(name, rep, v)
+        elif wt == 1:
+            v = struct.unpack_from("<d", buf, pos)[0]
+            pos += 8
+            if field:
+                name, kind, rep = field
+                put(name, rep, v)
+        elif wt == 2:
+            ln, pos = _dec_varint(buf, pos)
+            chunk_end = pos + ln
+            if field:
+                name, kind, rep = field
+                if isinstance(kind, dict):
+                    put(name, rep, decode(buf, kind, pos, chunk_end))
+                elif kind == "string":
+                    put(name, rep, buf[pos:chunk_end].decode("utf-8"))
+                elif kind == "bytes":
+                    put(name, rep, bytes(buf[pos:chunk_end]))
+                elif rep and kind in ("int", "float", "double"):
+                    # packed scalars
+                    p = pos
+                    while p < chunk_end:
+                        if kind == "int":
+                            v, p = _dec_varint(buf, p)
+                        elif kind == "float":
+                            v = struct.unpack_from("<f", buf, p)[0]
+                            p += 4
+                        else:
+                            v = struct.unpack_from("<d", buf, p)[0]
+                            p += 8
+                        put(name, True, v)
+            pos = chunk_end
+        else:  # pragma: no cover
+            raise ValueError(f"unsupported wire type {wt}")
+    return msg

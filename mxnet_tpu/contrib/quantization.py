@@ -282,6 +282,12 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
 
     prev_active = []
     _collect_active(net, prev_active)
+
+    def _restore_hybridization():
+        for b, active in prev_active:
+            if active:
+                b.hybridize(True)
+
     net.hybridize(False)
     calib = _Calib(calib_mode)
     handles = []
@@ -296,21 +302,18 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
                     break
                 x = batch if isinstance(batch, NDArray) else array(batch)
                 net(x)
+        missing = [l.name for _, _, l in targets
+                   if l.name not in calib.minmax]
+        if missing:
+            raise MXNetError(
+                f"calibration never reached layers {missing}; pass "
+                "calib_data that exercises the whole net")
     except Exception:
-        for b, active in prev_active:
-            if active:
-                b.hybridize(True)
+        _restore_hybridization()
         raise
     finally:
         for h in handles:
             h.detach()
-    missing = [l.name for _, _, l in targets if l.name not in calib.minmax]
-    if missing:
-        for b, active in prev_active:
-            if active:
-                b.hybridize(True)
-        raise MXNetError(f"calibration never reached layers {missing}; "
-                         "pass calib_data that exercises the whole net")
     if quantize_mode == "smart" and len(targets) > 1:
         # keep the OUTPUT layer fp32 — decided by execution order (hook
         # firing), not registration order, so custom blocks that register
@@ -331,9 +334,7 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
                 object.__setattr__(parent, attr, q)
     # restore the caller's hybridization state (new quantized blocks adopt
     # their parent's state) and invalidate caches up the tree
-    for b, active in prev_active:
-        if active:
-            b.hybridize(True)
+    _restore_hybridization()
 
     def _bump(b):
         b._bump_cache_version()

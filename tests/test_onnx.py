@@ -1,0 +1,178 @@
+"""ONNX export/import round-trip (reference:
+python/mxnet/contrib/onnx + tests/python-pytest/onnx — SURVEY.md §3.5)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+from mxnet_tpu.contrib.onnx import ir, wire
+
+
+def test_wire_codec_roundtrip():
+    t = ir.make_tensor("w", np.arange(12, dtype="f").reshape(3, 4))
+    blob = wire.encode(t, ir.TENSOR)
+    back = wire.decode(blob, ir.TENSOR)
+    np.testing.assert_allclose(ir.tensor_to_numpy(back),
+                               np.arange(12, dtype="f").reshape(3, 4))
+    assert back["name"] == "w"
+    assert back["dims"] == [3, 4]
+
+
+def test_wire_codec_packed_and_unpacked_ints():
+    # packed encode (ours) must decode; unpacked (old proto2 style) too
+    msg = {"dims": [2, 3, 4], "data_type": 1, "name": "x"}
+    blob = wire.encode(msg, ir.TENSOR)
+    assert wire.decode(blob, ir.TENSOR)["dims"] == [2, 3, 4]
+    unpacked = bytearray()
+    for d in (2, 3, 4):
+        unpacked.append((1 << 3) | 0)  # field 1, varint
+        unpacked.append(d)
+    assert wire.decode(bytes(unpacked), ir.TENSOR)["dims"] == [2, 3, 4]
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu", in_units=8),
+            gluon.nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_export_import_mlp_roundtrip(tmp_path):
+    net = _mlp()
+    x = np.random.RandomState(0).uniform(-1, 1, (5, 8)).astype("f")
+    ref = net(nd.array(x)).asnumpy()
+    path = str(tmp_path / "mlp.onnx")
+    onnx_mxnet.export_model(net, input_shape=(5, 8), onnx_file_path=path)
+
+    sym, arg_params, aux_params = onnx_mxnet.import_model(path)
+    data_name = [n for n in sym.list_arguments() if n not in arg_params
+                 and n not in aux_params][0]
+    out = sym.eval(**{data_name: nd.array(x)},
+                   **{k: v for k, v in arg_params.items()})
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_export_import_convnet_roundtrip(tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(6, 3, padding=1, activation="relu", in_channels=3),
+            gluon.nn.BatchNorm(),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.GlobalAvgPool2D(),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    x = np.random.RandomState(1).uniform(-1, 1, (2, 3, 8, 8)).astype("f")
+    net(nd.array(x))  # settle + populate BN stats layout
+    ref = net(nd.array(x)).asnumpy()
+    path = str(tmp_path / "conv.onnx")
+    onnx_mxnet.export_model(net, input_shape=(2, 3, 8, 8),
+                            onnx_file_path=path)
+
+    block = onnx_mxnet.import_to_gluon(path)
+    out = block(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_import_classifies_bn_stats_as_aux(tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, in_channels=2), gluon.nn.BatchNorm())
+    net.initialize()
+    net(nd.ones((1, 2, 6, 6)))
+    path = str(tmp_path / "bn.onnx")
+    onnx_mxnet.export_model(net, input_shape=(1, 2, 6, 6),
+                            onnx_file_path=path)
+    sym, arg_params, aux_params = onnx_mxnet.import_model(path)
+    assert len(aux_params) == 2  # running mean + var
+    assert all(k.endswith(("running_mean", "running_var"))
+               for k in aux_params)
+
+
+def test_export_import_flatten_false_3d(tmp_path):
+    """Dense(flatten=False) on 3-D input exports as Transpose+MatMul(+Add)
+    (Gemm requires 2-D A) and round-trips."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(6, in_units=4, flatten=False))
+    net.initialize(mx.init.Xavier())
+    x = np.random.RandomState(2).uniform(-1, 1, (2, 5, 4)).astype("f")
+    ref = net(nd.array(x)).asnumpy()
+    path = str(tmp_path / "proj.onnx")
+    onnx_mxnet.export_model(net, input_shape=(2, 5, 4), onnx_file_path=path)
+    model = ir.parse_model(open(path, "rb").read())
+    ops = [n["op_type"] for n in model["graph"]["node"]]
+    assert "Gemm" not in ops and "MatMul" in ops
+    block = onnx_mxnet.import_to_gluon(path)
+    out = block(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_import_gemm_alpha_beta(tmp_path):
+    """Gemm alpha/beta from foreign exporters fold into the params."""
+    w = np.random.RandomState(3).randn(4, 3).astype("f")
+    b = np.random.RandomState(4).randn(4).astype("f")
+    x = np.random.RandomState(5).randn(2, 3).astype("f")
+    graph = {"name": "g",
+             "node": [ir.make_node("Gemm", ["x", "w", "b"], ["y"],
+                                   alpha=0.5, beta=2.0, transB=1)],
+             "initializer": [ir.make_tensor("w", w), ir.make_tensor("b", b)],
+             "input": [ir.make_value_info("x", (2, 3))],
+             "output": [ir.make_value_info("y", (2, 4))]}
+    path = str(tmp_path / "gemm.onnx")
+    with open(path, "wb") as f:
+        f.write(ir.serialize_model(ir.make_model(graph)))
+    block = onnx_mxnet.import_to_gluon(path)
+    out = block(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, 0.5 * (x @ w.T) + 2.0 * b,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_import_initializers_listed_as_inputs(tmp_path):
+    """keep_initializers_as_inputs-style files: weights in graph.input must
+    not become runtime inputs."""
+    w = np.random.RandomState(6).randn(4, 3).astype("f")
+    x = np.random.RandomState(7).randn(2, 3).astype("f")
+    graph = {"name": "g",
+             "node": [ir.make_node("Gemm", ["x", "w"], ["y"], transB=1)],
+             "initializer": [ir.make_tensor("w", w)],
+             "input": [ir.make_value_info("x", (2, 3)),
+                       ir.make_value_info("w", (4, 3))],
+             "output": [ir.make_value_info("y", (2, 4))]}
+    path = str(tmp_path / "old.onnx")
+    with open(path, "wb") as f:
+        f.write(ir.serialize_model(ir.make_model(graph)))
+    block = onnx_mxnet.import_to_gluon(path)
+    out = block(nd.array(x)).asnumpy()  # single runtime input
+    np.testing.assert_allclose(out, x @ w.T, rtol=1e-5, atol=1e-6)
+
+
+def test_fp16_int32_data_bit_reinterpretation():
+    one_half = np.array([15360, 14336], dtype="int32")  # fp16 bits 1.0, 0.5
+    t = {"name": "h", "dims": [2], "data_type": ir.DT["float16"],
+         "int32_data": list(one_half)}
+    got = ir.tensor_to_numpy(t)
+    np.testing.assert_allclose(got.astype("f"), [1.0, 0.5])
+
+
+def test_export_unsupported_op_raises(tmp_path):
+    sym = mx.sym.var("x")
+    y = mx.sym.gammaln(sym)
+    with pytest.raises(mx.MXNetError):
+        onnx_mxnet.export_model(y, {}, input_shape=(2,),
+                                onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_model_proto_structure(tmp_path):
+    """The serialized file must carry ir_version/opset/graph so standard
+    ONNX tooling can read it."""
+    net = _mlp()
+    path = str(tmp_path / "m.onnx")
+    onnx_mxnet.export_model(net, input_shape=(1, 8), onnx_file_path=path)
+    model = ir.parse_model(open(path, "rb").read())
+    assert model["ir_version"] == ir.IR_VERSION
+    assert model["opset_import"][0]["version"] == ir.OPSET_VERSION
+    g = model["graph"]
+    assert g["node"], "graph has nodes"
+    assert g["initializer"], "params exported as initializers"
+    assert g["input"] and g["output"]

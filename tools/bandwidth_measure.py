@@ -42,25 +42,37 @@ def measure_allreduce(size_bytes, iters=10, warmup=2, mesh=None):
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), ("dp",))
     n = mesh.size
-    elems = max(size_bytes // 4, n)
-    elems -= elems % n
-    # per-device distinct contributions, sharded over dp: the psum is a
-    # real cross-device reduction, not a broadcast-elision candidate
+    elems = max(size_bytes // 4, 1)
+    # every device contributes its own `elems`-float vector and receives
+    # the elementwise sum — the canonical allreduce setup (nccl-tests
+    # semantics).  shard_map + lax.psum guarantees a true all-reduce in
+    # the HLO (a reshard-to-replicated would compile to all-gather and
+    # overstate bandwidth ~2x).
     x = jax.device_put(
-        jnp.arange(elems, dtype=jnp.float32),
-        NamedSharding(mesh, P("dp")))
+        jnp.ones((n, elems), dtype=jnp.float32),
+        NamedSharding(mesh, P("dp", None)))
 
     @jax.jit
     def allreduce(v):
-        # sharded input -> replicated output forces the all-reduce
-        return jax.lax.with_sharding_constraint(
-            v * 1.0000001, NamedSharding(mesh, P())) + 0.0
+        def f(local):
+            return jax.lax.psum(local, "dp")
+
+        return shard_map(f, mesh=mesh, in_specs=P("dp", None),
+                         out_specs=P("dp", None))(v)
 
     out = allreduce(x)
     out.block_until_ready()
+    if n > 1 and "all-reduce" not in \
+            allreduce.lower(x).compile().as_text():
+        raise RuntimeError("collective did not lower to all-reduce")
     for _ in range(warmup):
         allreduce(x).block_until_ready()
     t0 = time.perf_counter()
