@@ -61,6 +61,9 @@ from . import io
 from . import recordio
 from . import image
 from . import profiler
+from . import checkpoint
+from . import visualization
+from . import visualization as viz
 from . import util
 from .util import test_utils
 from . import runtime

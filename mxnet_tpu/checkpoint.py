@@ -1,0 +1,196 @@
+"""Checkpoint/resume + failure recovery.
+
+Reference scope (SURVEY.md §6.3): MXNet 1.x ships Module.save_checkpoint /
+load_checkpoint and leaves elastic recovery to the operator; modern TPU
+jobs need the full loop — atomic checkpoints, auto-resume from the latest
+good step, and a supervised retry wrapper (the moral equivalent of the
+ps-lite worker-restart story, redesigned for SPMD jobs where every process
+restarts together).
+
+Design:
+- ``CheckpointManager``: step-indexed directory layout, ATOMIC publishes
+  (write to tmp, fsync, rename — a partially-written checkpoint is never
+  visible), bounded retention, ``latest_step()`` discovery for resume.
+  In a multi-process job only process 0 writes (weights are replicated);
+  all processes barrier on publish so no one resumes past a checkpoint a
+  peer has not finished.
+- ``run_with_recovery``: restarts a training function from the latest
+  checkpoint after transient failures (preemption, XLA OOM after
+  defragmentation, flaky interconnect) with bounded retries.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+
+from .base import MXNetError
+
+__all__ = ["CheckpointManager", "run_with_recovery"]
+
+
+def _fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:  # pragma: no cover - platforms without O_DIRECTORY
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class CheckpointManager:
+    """Atomic, step-indexed checkpoints for Gluon nets + Trainers.
+
+    Usage::
+
+        mgr = CheckpointManager(dir, max_to_keep=3)
+        start = mgr.restore(net, trainer)  # 0 if none yet
+        for epoch in range(start, n):
+            ...train...
+            mgr.save(epoch + 1, net, trainer)
+    """
+
+    def __init__(self, directory, max_to_keep=5):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+
+    # -- discovery ---------------------------------------------------------
+    def _step_dir(self, step):
+        return os.path.join(self.directory, f"step_{step:08d}")
+
+    def all_steps(self):
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_") and os.path.isdir(
+                    os.path.join(self.directory, name)) and \
+                    os.path.exists(os.path.join(self.directory, name,
+                                                "COMMITTED")):
+                out.append(int(name[len("step_"):]))
+        return sorted(out)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- save/restore ------------------------------------------------------
+    def save(self, step, net=None, trainer=None, extra=None):
+        """Publish checkpoint `step` atomically; returns its directory."""
+        import jax
+
+        primary = jax.process_index() == 0
+        final = self._step_dir(step)
+        try:
+            if primary:
+                tmp = tempfile.mkdtemp(prefix=f".tmp_step_{step}_",
+                                       dir=self.directory)
+                try:
+                    if net is not None:
+                        net.save_parameters(
+                            os.path.join(tmp, "model.params"))
+                    if trainer is not None:
+                        trainer.save_states(
+                            os.path.join(tmp, "trainer.states"))
+                    meta = {"step": int(step), "time": time.time()}
+                    if extra:
+                        meta["extra"] = extra
+                    with open(os.path.join(tmp, "meta.json"), "w") as f:
+                        json.dump(meta, f)
+                    # durability: every payload file reaches the platter
+                    # BEFORE the commit marker exists, and the marker +
+                    # directory entries before the publish rename
+                    for name in os.listdir(tmp):
+                        _fsync_file(os.path.join(tmp, name))
+                    with open(os.path.join(tmp, "COMMITTED"), "w") as f:
+                        f.write("1")
+                        f.flush()
+                        os.fsync(f.fileno())
+                    _fsync_dir(tmp)
+                    if os.path.exists(final):
+                        shutil.rmtree(final)
+                    os.rename(tmp, final)
+                    _fsync_dir(self.directory)
+                except Exception:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise
+                self._gc()
+        finally:
+            # ALL processes must reach the barrier even when the primary's
+            # write fails — otherwise the peers deadlock in the collective
+            self._barrier()
+        return final
+
+    def restore(self, net=None, trainer=None, step=None, ctx=None):
+        """Load the latest (or given) checkpoint; returns the step number,
+        or 0 when no checkpoint exists yet."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return 0
+        d = self._step_dir(step)
+        if not os.path.exists(os.path.join(d, "COMMITTED")):
+            raise MXNetError(f"checkpoint {d} is not committed")
+        if net is not None:
+            net.load_parameters(os.path.join(d, "model.params"), ctx=ctx)
+        if trainer is not None:
+            tpath = os.path.join(d, "trainer.states")
+            if os.path.exists(tpath):
+                trainer.load_states(tpath)
+        return step
+
+    def read_meta(self, step):
+        with open(os.path.join(self._step_dir(step), "meta.json")) as f:
+            return json.load(f)
+
+    def _gc(self):
+        steps = self.all_steps()
+        for s in steps[:-self.max_to_keep] if self.max_to_keep else []:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def _barrier(self):
+        import jax
+
+        if jax.process_count() > 1:
+            from .parallel.collectives import barrier
+
+            barrier()
+
+
+def run_with_recovery(train_fn, manager, max_restarts=3,
+                      should_retry=None, logger=None):
+    """Supervised training loop: ``train_fn(start_step, manager)`` runs to
+    completion or raises; on a retryable failure it is re-invoked from the
+    latest checkpoint (elastic semantics for preemptible TPU jobs).
+
+    ``should_retry(exc) -> bool`` filters failures (default: retry
+    everything except KeyboardInterrupt).  Returns train_fn's result."""
+    restarts = 0
+    while True:
+        start = manager.latest_step() or 0
+        try:
+            return train_fn(start, manager)
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:
+            if should_retry is not None and not should_retry(e):
+                raise
+            restarts += 1
+            if restarts > max_restarts:
+                raise MXNetError(
+                    f"training failed after {max_restarts} restarts "
+                    f"(last error: {e!r})") from e
+            if logger is not None:
+                logger.warning("restart %d/%d from step %s after: %r",
+                               restarts, max_restarts,
+                               manager.latest_step(), e)

@@ -667,6 +667,16 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
     recording = (_ag.is_recording() and od.differentiable
                  and any(isinstance(a, NDArray) and _on_tape(a) for a in nd_args if a is not None))
 
+    # per-op timing (reference: engine profiler op events).  Honest timing
+    # of an async dispatch requires a sync — same trade the reference's
+    # profiler makes via engine bulk-flush.  Snapshot the recorder: another
+    # thread's profiler.stop() must not null it mid-op.
+    _prof_rec = _PROFILE["record"] if _PROFILE["on"] else None
+    if _prof_rec is not None:
+        import time as _time
+
+        _prof_t0 = _time.perf_counter()
+
     if recording:
         entries = [(a._ag_entry if isinstance(a, NDArray) else None) for a in nd_args]
         out_vals, out_entries, multi = _ag.record_op(fn, in_vals, entries, name=opname)
@@ -674,6 +684,12 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
         out_vals = fn(*in_vals)
         multi = isinstance(out_vals, (tuple, list))
         out_entries = None
+
+    if _prof_rec is not None:
+        _sync = out_vals[0] if multi else out_vals
+        if hasattr(_sync, "block_until_ready"):
+            _sync.block_until_ready()
+        _prof_rec(opname, _prof_t0, _time.perf_counter())
 
     outs = list(out_vals) if multi else [out_vals]
     nd_outs = []
@@ -701,6 +717,10 @@ _SYMTRACE = {"on": False}
 # monkey-patches op namespaces — here one dict lookup gates the hot path).
 # "wrap": callable(opdef, fn) -> fn installed by contrib.amp.
 _AMP = {"on": False, "wrap": None}
+
+# per-op profiling state, owned by profiler.py ("record": callable(opname,
+# t0, t1) installed while profiling imperative ops is enabled)
+_PROFILE = {"on": False, "record": None}
 
 
 def _call_with_attrs(fn, attrs, *arrays):

@@ -1,69 +1,166 @@
-"""Profiler shim over jax.profiler.
+"""Profiler: per-op stats + Chrome trace over jax.profiler.
 
-Reference: ``python/mxnet/profiler.py`` + ``src/profiler/`` (operator-level
-Chrome-trace profiler — SURVEY.md §6.1).  TPU mapping: set_config/start/stop
-drive ``jax.profiler`` traces viewable in TensorBoard/Perfetto (per-HLO-op
-attribution replaces per-engine-op events); user scopes map to
-``jax.profiler.TraceAnnotation`` / named scopes.
+Reference: ``python/mxnet/profiler.py`` + ``src/profiler/`` (SURVEY.md
+§6.1): Chrome-trace event file, per-op aggregate statistics table
+(``dumps()``), user scopes/markers/counters.  TPU mapping:
+
+- ``start()/stop()`` also drive ``jax.profiler`` traces (XLA per-HLO-op
+  attribution, open in TensorBoard/Perfetto) — the on-device truth.
+- Python-level op events come from the ``invoke`` seam: when
+  ``profile_imperative`` (or profile_all) is set, each imperative op is
+  timed with a sync, exactly the trade the reference's profiler makes
+  (honest per-op wall time requires serializing the async engine).
+- ``dump()`` writes a standard Chrome ``traceEvents`` JSON (op spans,
+  markers as instant events, counters as counter events);
+  ``dumps()`` returns the aggregate per-op summary table.
 """
 from __future__ import annotations
 
+import json
 import os
+import threading
 import time
-from contextlib import contextmanager
 
 from .base import MXNetError
 
 __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
            "Task", "Frame", "Marker", "Counter", "Domain", "Scope"]
 
-_CONFIG = {"filename": "profile.json", "profile_all": False, "dir": None}
+_CONFIG = {"filename": "profile.json", "profile_all": False,
+           "profile_imperative": False, "dir": None, "jax_trace": True}
 _ACTIVE = False
+_PAUSED = False
+_LOCK = threading.Lock()
+_EVENTS = []   # chrome trace events
+_AGG = {}      # opname -> [count, total_s, min_s, max_s]
+_T0 = None
 
 
 def set_config(profile_all=False, profile_symbolic=False,
                profile_imperative=False, profile_memory=False,
                profile_api=False, filename="profile.json",
-               continuous_dump=False, **kwargs):
-    _CONFIG.update(profile_all=profile_all, filename=filename)
+               continuous_dump=False, jax_trace=True, **kwargs):
+    _CONFIG.update(profile_all=profile_all, filename=filename,
+                   profile_imperative=profile_imperative or profile_all,
+                   jax_trace=jax_trace)
     _CONFIG["dir"] = os.path.dirname(os.path.abspath(filename)) or "."
 
 
-def start():
-    global _ACTIVE
-    import jax
+def _record_op(opname, t0, t1):
+    with _LOCK:
+        _EVENTS.append({"name": opname, "ph": "X", "pid": 0,
+                        "tid": threading.get_ident() % 1000,
+                        "ts": (t0 - _T0) * 1e6, "dur": (t1 - t0) * 1e6,
+                        "cat": "operator"})
+        agg = _AGG.get(opname)
+        dt = t1 - t0
+        if agg is None:
+            _AGG[opname] = [1, dt, dt, dt]
+        else:
+            agg[0] += 1
+            agg[1] += dt
+            agg[2] = min(agg[2], dt)
+            agg[3] = max(agg[3], dt)
 
-    logdir = _CONFIG.get("dir") or "."
-    jax.profiler.start_trace(os.path.join(logdir, "jax_trace"))
+
+def _instant(name, cat):
+    if _T0 is None or not _ACTIVE or _PAUSED:
+        return
+    with _LOCK:
+        _EVENTS.append({"name": name, "ph": "i", "pid": 0, "s": "g",
+                        "tid": threading.get_ident() % 1000,
+                        "ts": (time.perf_counter() - _T0) * 1e6, "cat": cat})
+
+
+def _counter(name, value):
+    if _T0 is None or not _ACTIVE or _PAUSED:
+        return
+    with _LOCK:
+        _EVENTS.append({"name": name, "ph": "C", "pid": 0,
+                        "ts": (time.perf_counter() - _T0) * 1e6,
+                        "args": {name: value}})
+
+
+def start():
+    global _ACTIVE, _T0, _PAUSED
+    from .ndarray.ndarray import _PROFILE
+
+    _T0 = time.perf_counter()
+    _PAUSED = False
+    if _CONFIG.get("jax_trace", True):
+        import jax
+
+        logdir = _CONFIG.get("dir") or "."
+        jax.profiler.start_trace(os.path.join(logdir, "jax_trace"))
+    if _CONFIG.get("profile_imperative") or _CONFIG.get("profile_all"):
+        _PROFILE["record"] = _record_op
+        _PROFILE["on"] = True
     _ACTIVE = True
 
 
 def stop():
     global _ACTIVE
-    import jax
+    from .ndarray.ndarray import _PROFILE
 
-    if _ACTIVE:
+    if not _ACTIVE:
+        return
+    _PROFILE["on"] = False
+    _PROFILE["record"] = None
+    if _CONFIG.get("jax_trace", True):
+        import jax
+
         jax.profiler.stop_trace()
-        _ACTIVE = False
+    _ACTIVE = False
 
 
 def pause():
-    stop()
+    global _PAUSED
+    from .ndarray.ndarray import _PROFILE
+
+    _PAUSED = True
+    _PROFILE["on"] = False
 
 
 def resume():
-    start()
+    global _PAUSED
+    from .ndarray.ndarray import _PROFILE
+
+    if not _ACTIVE:  # resume without a prior start() is a no-op
+        return
+    _PAUSED = False
+    if _CONFIG.get("profile_imperative") or _CONFIG.get("profile_all"):
+        _PROFILE["record"] = _record_op
+        _PROFILE["on"] = True
 
 
 def dump(finished=True, profile_process="worker"):
-    """The jax trace is written at stop(); this records the pointer file."""
+    """Write the Chrome traceEvents file (open in chrome://tracing /
+    Perfetto; the XLA-level trace lives in jax_trace/ for TensorBoard)."""
+    with _LOCK:
+        events = list(_EVENTS)
     with open(_CONFIG["filename"], "w") as f:
-        f.write('{"note": "trace written by jax.profiler; open the '
-                'jax_trace/ directory in TensorBoard or Perfetto"}\n')
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms",
+                   "otherData": {
+                       "xla_trace": "see jax_trace/ (TensorBoard)"}}, f)
+    return _CONFIG["filename"]
 
 
 def dumps(reset=False):
-    return "<profile data in jax_trace/; open with TensorBoard>"
+    """Aggregate per-op statistics table (reference: profiler.dumps)."""
+    with _LOCK:
+        rows = [(name, a[0], a[1] * 1e3, a[2] * 1e3, a[3] * 1e3,
+                 a[1] / a[0] * 1e3) for name, a in sorted(_AGG.items())]
+        if reset:
+            _AGG.clear()
+            _EVENTS.clear()
+    lines = ["Profile Statistics:",
+             f"{'Name':<32}{'Total Count':>12}{'Total(ms)':>12}"
+             f"{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}"]
+    for name, cnt, tot, mn, mx, avg in rows:
+        lines.append(f"{name:<32}{cnt:>12}{tot:>12.3f}{mn:>10.3f}"
+                     f"{mx:>10.3f}{avg:>10.3f}")
+    return "\n".join(lines)
 
 
 class Domain:
@@ -75,17 +172,23 @@ class _Scope:
     def __init__(self, name):
         self.name = name
         self._ctx = None
+        self._t0 = None
 
     def start(self):
         import jax
 
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
+        self._t0 = time.perf_counter()
 
     def stop(self):
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
+        if self._t0 is not None and _T0 is not None and _ACTIVE \
+                and not _PAUSED:
+            _record_op(f"scope:{self.name}", self._t0, time.perf_counter())
+        self._t0 = None
 
     def __enter__(self):
         self.start()
@@ -107,26 +210,40 @@ class Frame(_Scope):
 
 
 class Marker:
+    """Instant event in the trace (reference: profiler.Marker.mark)."""
+
     def __init__(self, domain=None, name="marker"):
         self.name = name
 
     def mark(self, scope="process"):
-        pass
+        _instant(self.name, "marker")
 
 
 class Counter:
+    """Named counter recorded into the trace (reference: profiler.Counter)."""
+
     def __init__(self, domain=None, name="counter", value=0):
         self.name = name
         self.value = value
+        _counter(self.name, value)
 
     def set_value(self, value):
         self.value = value
+        _counter(self.name, value)
 
     def increment(self, delta=1):
-        self.value += delta
+        self.set_value(self.value + delta)
 
     def decrement(self, delta=1):
-        self.value -= delta
+        self.set_value(self.value - delta)
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
 
 
 Scope = _Scope

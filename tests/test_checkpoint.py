@@ -1,0 +1,125 @@
+"""Checkpoint/resume + elastic recovery (SURVEY.md §6.3 — net-new vs the
+reference's Module.save_checkpoint story)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.checkpoint import CheckpointManager, run_with_recovery
+
+
+def _net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=4, activation="relu"),
+            gluon.nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _step(net, trainer, X, Y):
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = lf(net(nd.array(X)), nd.array(Y))
+    loss.backward()
+    trainer.step(X.shape[0])
+    return float(loss.mean().asscalar())
+
+
+def test_save_restore_roundtrip(tmp_path):
+    R = np.random.RandomState(0)
+    X = R.randn(16, 4).astype("f")
+    Y = (X.sum(1) > 0).astype("f")
+    net = _net()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr.restore(net, tr) == 0
+    for i in range(3):
+        _step(net, tr, X, Y)
+    mgr.save(3, net, tr, extra={"note": "epoch3"})
+    want = net(nd.array(X)).asnumpy()
+
+    net2 = _net()
+    tr2 = gluon.Trainer(net2.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9})
+    net2(nd.array(X))
+    mgr2 = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr2.restore(net2, tr2) == 3
+    np.testing.assert_allclose(net2(nd.array(X)).asnumpy(), want, rtol=1e-6)
+    assert mgr2.read_meta(3)["extra"]["note"] == "epoch3"
+    # trainer momentum restored: one more step must match exactly
+    l1 = _step(net, tr, X, Y)
+    l2 = _step(net2, tr2, X, Y)
+    assert abs(l1 - l2) < 1e-6
+
+
+def test_retention_and_latest(tmp_path):
+    net = _net()
+    net(nd.ones((1, 4)))
+    mgr = CheckpointManager(str(tmp_path / "c"), max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        mgr.save(s, net)
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.latest_step() == 4
+
+
+def test_uncommitted_checkpoint_invisible(tmp_path):
+    net = _net()
+    net(nd.ones((1, 4)))
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(1, net)
+    # simulate a torn write: committed marker missing
+    os.makedirs(str(tmp_path / "c" / "step_00000002"))
+    open(str(tmp_path / "c" / "step_00000002" / "model.params"), "w").close()
+    assert mgr.latest_step() == 1
+
+
+def test_run_with_recovery_resumes_from_checkpoint(tmp_path):
+    """A crashing train_fn resumes from the last published step."""
+    R = np.random.RandomState(1)
+    X = R.randn(16, 4).astype("f")
+    Y = (X.sum(1) > 0).astype("f")
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    attempts = []
+
+    def train(start, manager):
+        net = _net()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        net(nd.array(X))
+        manager.restore(net, tr)
+        attempts.append(start)
+        for epoch in range(start, 4):
+            _step(net, tr, X, Y)
+            manager.save(epoch + 1, net, tr)
+            if epoch == 1 and len(attempts) == 1:
+                raise RuntimeError("simulated preemption")
+        return "done", net(nd.array(X)).asnumpy()
+
+    status, _ = run_with_recovery(train, mgr, max_restarts=2)
+    assert status == "done"
+    assert attempts == [0, 2]  # resumed from step 2, not from scratch
+    assert mgr.latest_step() == 4
+
+
+def test_run_with_recovery_bounded(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"))
+
+    def always_fails(start, manager):
+        raise RuntimeError("boom")
+
+    with pytest.raises(mx.MXNetError):
+        run_with_recovery(always_fails, mgr, max_restarts=2)
+
+
+def test_should_retry_filter(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"))
+
+    def fails(start, manager):
+        raise ValueError("not retryable")
+
+    with pytest.raises(ValueError):
+        run_with_recovery(fails, mgr, max_restarts=5,
+                          should_retry=lambda e: not isinstance(e, ValueError))

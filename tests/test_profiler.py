@@ -1,0 +1,84 @@
+"""Profiler: per-op stats, Chrome trace, markers/counters (reference:
+python/mxnet/profiler.py + tests test_profiler.py — SURVEY.md §6.1)."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+
+
+@pytest.fixture
+def prof(tmp_path):
+    f = str(tmp_path / "profile.json")
+    profiler.set_config(profile_imperative=True, filename=f, jax_trace=False)
+    profiler.start()
+    yield f
+    profiler.stop()
+    profiler.dumps(reset=True)
+    profiler.set_config(profile_imperative=False, jax_trace=True)
+
+
+def test_per_op_stats_and_dump(prof):
+    a = nd.ones((32, 32))
+    for _ in range(3):
+        b = nd.dot(a, a)
+    b.wait_to_read()
+    _ = nd.relu(a)
+    profiler.stop()
+
+    table = profiler.dumps()
+    assert "dot" in table and "relu" in table
+    lines = [l for l in table.splitlines() if l.startswith("dot")]
+    assert lines and int(lines[0].split()[1]) == 3  # count column
+
+    path = profiler.dump()
+    trace = json.load(open(path))
+    ops = [e for e in trace["traceEvents"] if e.get("cat") == "operator"]
+    assert sum(1 for e in ops if e["name"] == "dot") == 3
+    assert all("dur" in e and "ts" in e for e in ops)
+
+
+def test_marker_and_counter_events(prof):
+    m = profiler.Marker(name="epoch_end")
+    m.mark()
+    c = profiler.Counter(name="samples", value=0)
+    c.increment(32)
+    c += 32
+    profiler.stop()
+    path = profiler.dump()
+    trace = json.load(open(path))
+    kinds = {(e["ph"], e["name"]) for e in trace["traceEvents"]}
+    assert ("i", "epoch_end") in kinds
+    counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    assert counters and counters[-1]["args"]["samples"] == 64
+    assert c.value == 64
+
+
+def test_scope_recorded(prof):
+    with profiler.Scope("my_phase"):
+        nd.ones((4,)).wait_to_read()
+    profiler.stop()
+    assert "scope:my_phase" in profiler.dumps()
+
+
+def test_pause_resume(prof):
+    nd.sqrt(nd.ones((4,))).wait_to_read()
+    profiler.pause()
+    nd.exp(nd.ones((4,))).wait_to_read()
+    profiler.resume()
+    nd.log(nd.ones((4,))).wait_to_read()
+    profiler.stop()
+    table = profiler.dumps()
+    assert "sqrt" in table and "log" in table
+    assert "exp" not in table  # paused window not recorded
+
+
+def test_profiling_off_has_no_overhead_path():
+    """With profiling off the invoke seam must not record or sync."""
+    from mxnet_tpu.ndarray.ndarray import _PROFILE
+
+    assert _PROFILE["on"] is False
+    nd.ones((4,)).wait_to_read()
+    assert not profiler.dumps(reset=True).count("ones")

@@ -1,0 +1,49 @@
+"""plot_network / print_summary (reference: python/mxnet/visualization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+
+def _sym():
+    x = mx.sym.var("data")
+    w = mx.sym.var("fc_weight")
+    b = mx.sym.var("fc_bias")
+    h = mx.sym.FullyConnected(x, w, b, num_hidden=8, name="fc")
+    return mx.sym.relu(h, name="act")
+
+
+def test_plot_network_dot_source(tmp_path):
+    g = mx.viz.plot_network(_sym(), title="net")
+    assert g.source.startswith('digraph "net"')
+    assert "FullyConnected" in g.source
+    assert "fc_weight" not in g.source  # hidden by default
+    path = g.render(str(tmp_path / "net"))
+    assert open(path).read() == g.source
+
+
+def test_plot_network_show_weights():
+    g = mx.viz.plot_network(_sym(), hide_weights=False)
+    assert "fc_weight" in g.source
+
+
+def test_print_summary(capsys):
+    text = mx.viz.print_summary(_sym())
+    assert "fc (FullyConnected)" in text
+    assert "act (relu)" in text
+
+
+def test_plot_network_from_gluon_trace():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, in_units=3, activation="relu"))
+    net.initialize()
+    sym, _, _ = net._trace_to_symbol(nd.ones((1, 3)))
+    sym = sym if not isinstance(sym, (list, tuple)) else sym[0]
+    g = mx.viz.plot_network(sym)
+    assert "FullyConnected" in g.source
+
+
+def test_plot_network_rejects_non_symbol():
+    with pytest.raises(mx.MXNetError):
+        mx.viz.plot_network("not a symbol")
