@@ -534,11 +534,12 @@ class HybridBlock(Block):
         outs = out if isinstance(out, (list, tuple)) else [out]
         heads = [o._symhead for o in outs]
         sym = Symbol(heads)
-        from ..symbol.symbol import _AUX_SUFFIXES
-
+        # classify by graph position (the symbol knows which vars feed
+        # state-op aux slots), not by name suffix
+        aux_names = set(sym.list_auxiliary_states())
         arg_params, aux_params = {}, {}
         for name, p in plist:
-            if name.endswith(_AUX_SUFFIXES):
+            if name in aux_names:
                 aux_params[name] = p.data()
             else:
                 arg_params[name] = p.data()

@@ -86,6 +86,24 @@ def sparse_retain(arr, row_ids):
     return sparse.retain(arr, row_ids)
 
 
+# sparse-aware dot dispatch: csr lhs takes the SpMM path (segment-sum over
+# nnz), dense falls through to the registry op (reference: dot FComputeEx)
+_dense_dot = dot  # codegen'd above from the op table
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None, **kw):
+    if isinstance(lhs, sparse.CSRNDArray) and \
+            not isinstance(rhs, sparse._SparseBase):
+        res = sparse.dot(lhs, rhs, transpose_a=transpose_a,
+                         transpose_b=transpose_b)
+        if out is not None:
+            out._set(res._get().astype(out._get().dtype))
+            return out
+        return res
+    return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                      transpose_b=transpose_b, out=out, **kw)
+
+
 # -- convenience overrides with MXNet positional signatures ----------------
 def zeros(shape, ctx=None, dtype="float32", **kw):
     return invoke("zeros", [], {"shape": _shape_t(shape), "dtype": dtype}, ctx=ctx)

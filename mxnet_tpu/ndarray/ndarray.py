@@ -395,7 +395,9 @@ class NDArray:
         return self._binary("broadcast_power", o, reverse=True)
 
     def __matmul__(self, o):
-        return invoke("dot", [self, o], {})
+        from . import dot as _dot  # storage-dispatching (csr SpMM path)
+
+        return _dot(self, o)
 
     def __neg__(self):
         return invoke("negative", [self], {})
@@ -593,8 +595,10 @@ class NDArray:
                                        "is_ascend": is_ascend})
 
     def dot(self, other, transpose_a=False, transpose_b=False):
-        return invoke("dot", [self, other], {"transpose_a": transpose_a,
-                                             "transpose_b": transpose_b})
+        from . import dot as _dot  # storage-dispatching (csr SpMM path)
+
+        return _dot(self, other, transpose_a=transpose_a,
+                    transpose_b=transpose_b)
 
     def one_hot(self, depth, on_value=1.0, off_value=0.0):
         return invoke("one_hot", [self], {"depth": depth, "on_value": on_value,

@@ -286,6 +286,13 @@ def dot_csr_dense(csr, dense, transpose_a=False):
 
     jnp = _jnp()
     dn = dense._get() if isinstance(dense, NDArray) else jnp.asarray(dense)
+    want = csr._csr_shape[0] if transpose_a else csr._csr_shape[1]
+    if dn.shape[0] != want:
+        # jax clamps out-of-bounds gathers, which would return silently
+        # wrong values — fail like the dense path does
+        raise MXNetError(
+            f"dot: csr shape {csr._csr_shape} (transpose_a={transpose_a}) "
+            f"incompatible with rhs shape {tuple(dn.shape)}")
     data = csr._csr_data
     cols = csr._csr_indices
     indptr = csr._csr_indptr
@@ -311,10 +318,12 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         if transpose_b:
             raise MXNetError("transpose_b unsupported for csr dot")
         return dot_csr_dense(lhs, rhs, transpose_a=transpose_a)
-    from . import dot as _dense_dot
+    # fall back to the registry op directly (densifies via _get); going
+    # through the module-level mx.nd.dot wrapper would recurse
+    from .ndarray import invoke
 
-    return _dense_dot(lhs, rhs, transpose_a=transpose_a,
-                      transpose_b=transpose_b)
+    return invoke("dot", [lhs, rhs], {"transpose_a": transpose_a,
+                                      "transpose_b": transpose_b})
 
 
 def cast_storage(arr, stype):

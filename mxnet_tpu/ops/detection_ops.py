@@ -404,3 +404,281 @@ def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
     r, c = jax.vmap(single)(flat)
     return (r.reshape(data.shape[:-2] + (data.shape[-2],)),
             c.reshape(data.shape[:-2] + (data.shape[-1],)))
+
+
+# ==========================================================================
+# RPN Proposal (reference: src/operator/contrib/proposal.cc — the two-stage
+# detector region-proposal op).  TPU-first: fixed shapes end to end —
+# anchors enumerated on a static grid, top-K via lax.top_k, suppression via
+# the same O(N²) masked NMS as box_nms, output padded to rpn_post_nms_top_n.
+# ==========================================================================
+def _enum_anchors(feat_h, feat_w, stride, scales, ratios, base_size):
+    jnp = _jnp()
+
+    base = jnp.asarray([0, 0, base_size - 1.0, base_size - 1.0])
+    cx = (base[0] + base[2]) * 0.5
+    cy = (base[1] + base[3]) * 0.5
+    w = base[2] - base[0] + 1.0
+    h = base[3] - base[1] + 1.0
+    size = w * h
+    anchors = []
+    for r in ratios:
+        ws = jnp.round(jnp.sqrt(size / r))
+        hs = jnp.round(ws * r)
+        for s in scales:
+            anchors.append(jnp.stack([cx - 0.5 * (ws * s - 1),
+                                      cy - 0.5 * (hs * s - 1),
+                                      cx + 0.5 * (ws * s - 1),
+                                      cy + 0.5 * (hs * s - 1)]))
+    A = jnp.stack(anchors)                                     # (A, 4)
+    sx = jnp.arange(feat_w) * stride
+    sy = jnp.arange(feat_h) * stride
+    shift = jnp.stack(jnp.meshgrid(sx, sy, indexing="xy"), axis=-1)
+    shift = jnp.concatenate([shift, shift], axis=-1)           # (h, w, 4)
+    return (shift[:, :, None, :] + A[None, None]).reshape(-1, 4)
+
+
+def _bbox_transform_inv(anchors, deltas):
+    jnp = _jnp()
+
+    w = anchors[:, 2] - anchors[:, 0] + 1.0
+    h = anchors[:, 3] - anchors[:, 1] + 1.0
+    cx = anchors[:, 0] + 0.5 * (w - 1)
+    cy = anchors[:, 1] + 0.5 * (h - 1)
+    dx, dy, dw, dh = deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3]
+    pcx = dx * w + cx
+    pcy = dy * h + cy
+    pw = jnp.exp(dw) * w
+    ph = jnp.exp(dh) * h
+    return jnp.stack([pcx - 0.5 * (pw - 1), pcy - 0.5 * (ph - 1),
+                      pcx + 0.5 * (pw - 1), pcy + 0.5 * (ph - 1)], axis=1)
+
+
+@register("_contrib_Proposal", aliases=("Proposal", "proposal"),
+          differentiable=False)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """RPN proposals: anchors + deltas -> clip -> min-size -> top-K -> NMS.
+
+    cls_prob (N, 2A, h, w) [bg scores first A maps, fg last A],
+    bbox_pred (N, 4A, h, w), im_info (N, 3) [height, width, scale].
+    Output: (N * post_nms_top_n, 5) rows [batch_idx, x1, y1, x2, y2]
+    (+ scores when output_score), padded with the top box like the
+    reference."""
+    import jax
+    jnp = _jnp()
+
+    n, a2, h, w = cls_prob.shape
+    A = a2 // 2
+    anchors = _enum_anchors(h, w, feature_stride, scales, ratios,
+                            float(feature_stride))
+
+    def one(scores_map, deltas_map, info):
+        # fg scores: channels A..2A, layout (A,h,w) -> (h,w,A) -> flat
+        fg = scores_map[A:].transpose(1, 2, 0).reshape(-1)
+        deltas = deltas_map.reshape(A, 4, h, w).transpose(2, 3, 0, 1)
+        deltas = deltas.reshape(-1, 4)
+        boxes = _bbox_transform_inv(anchors, deltas)
+        # clip to image
+        im_h, im_w = info[0], info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, im_w - 1.0),
+            jnp.clip(boxes[:, 1], 0, im_h - 1.0),
+            jnp.clip(boxes[:, 2], 0, im_w - 1.0),
+            jnp.clip(boxes[:, 3], 0, im_h - 1.0)], axis=1)
+        # min-size filter (scaled like the reference)
+        min_size = rpn_min_size * info[2]
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        fg = jnp.where((ws >= min_size) & (hs >= min_size), fg, -1.0)
+        # pre-NMS top-K (static K)
+        k = min(rpn_pre_nms_top_n, fg.shape[0])
+        top_scores, top_idx = jax.lax.top_k(fg, k)
+        top_boxes = boxes[top_idx]
+        rows = jnp.concatenate([top_scores[:, None], top_boxes], axis=1)
+        kept = box_nms(rows, overlap_thresh=threshold, valid_thresh=0.0,
+                       coord_start=1, score_index=0, id_index=-1)
+        kept_scores = kept[:, 0]
+        order = jnp.argsort(-kept_scores)
+        kept = kept[order][:rpn_post_nms_top_n]
+        kept_scores = kept[:, 0]
+        # pad suppressed slots with the best box (reference pads output)
+        best = kept[0]
+        valid = kept_scores > 0
+        out_boxes = jnp.where(valid[:, None], kept[:, 1:5], best[1:5])
+        out_scores = jnp.where(valid, kept_scores, 0.0)
+        pad = rpn_post_nms_top_n - out_boxes.shape[0]
+        if pad > 0:
+            out_boxes = jnp.concatenate(
+                [out_boxes, jnp.tile(best[1:5], (pad, 1))])
+            out_scores = jnp.concatenate([out_scores, jnp.zeros(pad)])
+        return out_boxes, out_scores
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(n, dtype=boxes.dtype),
+                           rpn_post_nms_top_n)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+# ==========================================================================
+# DeformableConvolution (reference: src/operator/contrib/
+# deformable_convolution.cc — DCNv1).  TPU-first: the offset sampling is a
+# dense bilinear gather (pure jnp, fuses fine), the contraction is one
+# einsum onto the MXU; no im2col scratch in HBM beyond what XLA schedules.
+# ==========================================================================
+@register("_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution", "deformable_convolution"))
+def deformable_convolution(data, offset, weight, *maybe_bias, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=None, num_group=1,
+                           num_deformable_group=1, no_bias=False,
+                           workspace=None, layout=None):
+    """data (N,C,H,W); offset (N, 2*dg*kh*kw, oh, ow) [dy,dx interleaved
+    per tap]; weight (O, C/g, kh, kw)."""
+    import jax
+    jnp = _jnp()
+
+    n, c, hh, ww = data.shape
+    kh, kw = kernel
+    sh, sw = stride if stride else (1, 1)
+    dh, dw = dilate if dilate else (1, 1)
+    ph, pw = pad if pad else (0, 0)
+    oh = (hh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (ww + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = num_deformable_group
+    cg = c // dg
+
+    ys = jnp.arange(oh) * sh - ph
+    xs = jnp.arange(ow) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = ky[:, None] + ys[None, :]                   # (kh, oh)
+    base_x = kx[:, None] + xs[None, :]                   # (kw, ow)
+
+    def bilinear(img, y, x):
+        """img (C', H, W); y/x (...) fractional coords -> (C', ...)"""
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        wy = y - y0
+        wx = x - x0
+
+        def at(yy, xx):
+            inb = (yy >= 0) & (yy < hh) & (xx >= 0) & (xx < ww)
+            yy = jnp.clip(yy, 0, hh - 1).astype(jnp.int32)
+            xx = jnp.clip(xx, 0, ww - 1).astype(jnp.int32)
+            v = img[:, yy, xx]
+            return jnp.where(inb[None], v, 0.0)
+
+        return (at(y0, x0) * (1 - wy) * (1 - wx) +
+                at(y0 + 1, x0) * wy * (1 - wx) +
+                at(y0, x0 + 1) * (1 - wy) * wx +
+                at(y0 + 1, x0 + 1) * wy * wx)
+
+    def one(img, off):
+        # off (2*dg*kh*kw, oh, ow) -> (dg, kh, kw, 2, oh, ow)
+        off = off.reshape(dg, kh, kw, 2, oh, ow)
+        cols = []
+        for g in range(dg):
+            oy = off[g, :, :, 0]                         # (kh, kw, oh, ow)
+            ox = off[g, :, :, 1]
+            y = base_y[:, None, :, None] + oy            # (kh, kw, oh, ow)
+            x = base_x[None, :, None, :] + ox
+            sampled = bilinear(img[g * cg:(g + 1) * cg], y, x)
+            cols.append(sampled)                         # (cg, kh, kw, oh, ow)
+        return jnp.concatenate(cols, axis=0)             # (C, kh,kw,oh,ow)
+
+    cols = jax.vmap(one)(data, offset)                   # (N, C, kh,kw,oh,ow)
+    cpg = c // num_group
+    opg = num_filter // num_group
+    cols_g = cols.reshape(n, num_group, cpg, kh, kw, oh, ow)
+    w_g = weight.reshape(num_group, opg, cpg, kh, kw)
+    out = jnp.einsum("ngcklyx,gockl->ngoyx", cols_g, w_g,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(n, num_filter, oh, ow).astype(data.dtype)
+    if maybe_bias and not no_bias:
+        out = out + maybe_bias[0].reshape(1, -1, 1, 1)
+    return out
+
+
+# ==========================================================================
+# PSROIPooling (reference: src/operator/contrib/psroi_pooling.cc — R-FCN's
+# position-sensitive pooling).  TPU-first: fixed-size sampled average per
+# bin (the ROIAlign-style regular grid), channels split into pooled_size²
+# position groups.
+# ==========================================================================
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling", "psroi_pooling"))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
+                  pooled_size=7, group_size=None, sample_per_part=2):
+    """data (N, output_dim*g*g, H, W); rois (R, 5) [batch, x1,y1,x2,y2].
+    Output (R, output_dim, g, g) with bin (i,j) read from channel group
+    (i*g+j)."""
+    import jax
+    jnp = _jnp()
+
+    g = group_size or pooled_size
+    n, ctot, hh, ww = data.shape
+    if output_dim is not None and ctot != output_dim * g * g:
+        raise ValueError(
+            f"PSROIPooling: {ctot} channels != output_dim*group_size² "
+            f"({output_dim}*{g}²={output_dim * g * g})")
+    s = sample_per_part
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw = rw / g
+        bh = rh / g
+        img = jnp.take(data, b, axis=0)                  # (C, H, W)
+
+        iy = jnp.arange(g)
+        ix = jnp.arange(g)
+        sy = (jnp.arange(s) + 0.5) / s
+        sx = (jnp.arange(s) + 0.5) / s
+        # sample points per bin: (g, s) coords each axis
+        yy = y1 + (iy[:, None] + sy[None, :]) * bh       # (g, s)
+        xx = x1 + (ix[:, None] + sx[None, :]) * bw
+        yy = jnp.clip(yy, 0, hh - 1)
+        xx = jnp.clip(xx, 0, ww - 1)
+
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        wy = yy - y0
+        wx = xx - x0
+        y1i = jnp.minimum(y0 + 1, hh - 1)
+        x1i = jnp.minimum(x0 + 1, ww - 1)
+
+        cmap = data.shape[1] // (g * g)                  # = output_dim
+        chan = (iy[:, None] * g + ix[None, :])           # (g, g) group idx
+        # channel index per (dim, gy, gx): dim*g*g + group
+        dims = jnp.arange(cmap)
+        ch = dims[:, None, None] * g * g + chan[None]    # (dim, g, g)
+
+        def gather(yi, xi):
+            # (dim,g,g) channels x (g,s) y x (g,s) x -> (dim,g,g,s,s)
+            return img[ch[:, :, :, None, None],
+                       yi[None, :, None, :, None],
+                       xi[None, None, :, None, :]]
+
+        # four-corner bilinear; wy (g,s) indexed by (gy,sy), wx by (gx,sx)
+        wy_b = wy[:, None, :, None]                      # (g,1,s,1)
+        wx_b = wx[None, :, None, :]                      # (1,g,1,s)
+        v00 = gather(y0, x0)
+        v10 = gather(y1i, x0)
+        v01 = gather(y0, x1i)
+        v11 = gather(y1i, x1i)
+        out = (v00 * ((1 - wy_b) * (1 - wx_b))[None] +
+               v10 * (wy_b * (1 - wx_b))[None] +
+               v01 * ((1 - wy_b) * wx_b)[None] +
+               v11 * (wy_b * wx_b)[None])
+        return out.mean(axis=(3, 4))                     # (dim, g, g)
+
+    return jax.vmap(one_roi)(rois).astype(data.dtype)

@@ -1,0 +1,143 @@
+"""Proposal / DeformableConvolution / PSROIPooling (reference:
+src/operator/contrib/{proposal,deformable_convolution,psroi_pooling}.cc —
+SURVEY.md §3.2 detection contrib row)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_proposal_shapes_and_validity():
+    R = np.random.RandomState(0)
+    n, A, h, w = 2, 6, 8, 8  # 2 scales x 3 ratios won't match; use 6 = len(scales)*len(ratios)
+    scales, ratios = (8, 16), (0.5, 1.0, 2.0)
+    A = len(scales) * len(ratios)
+    cls_prob = R.uniform(0, 1, (n, 2 * A, h, w)).astype("f")
+    bbox_pred = (R.randn(n, 4 * A, h, w) * 0.1).astype("f")
+    im_info = np.array([[128, 128, 1.0], [128, 128, 1.0]], "f")
+    rois = nd.contrib.Proposal(nd.array(cls_prob), nd.array(bbox_pred),
+                               nd.array(im_info), rpn_pre_nms_top_n=200,
+                               rpn_post_nms_top_n=30, threshold=0.7,
+                               rpn_min_size=4, scales=scales, ratios=ratios,
+                               feature_stride=16)
+    out = rois.asnumpy()
+    assert out.shape == (2 * 30, 5)
+    # batch indices correct, boxes inside the image, well-formed
+    assert set(np.unique(out[:, 0])) <= {0.0, 1.0}
+    assert (out[:, 1] >= 0).all() and (out[:, 3] <= 127).all()
+    assert (out[:, 2] >= 0).all() and (out[:, 4] <= 127).all()
+    assert (out[:, 3] >= out[:, 1]).all() and (out[:, 4] >= out[:, 2]).all()
+
+
+def test_proposal_output_score():
+    R = np.random.RandomState(1)
+    scales, ratios = (8,), (1.0,)
+    cls_prob = R.uniform(0, 1, (1, 2, 4, 4)).astype("f")
+    bbox_pred = np.zeros((1, 4, 4, 4), "f")
+    im_info = np.array([[64, 64, 1.0]], "f")
+    rois, scores = nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_post_nms_top_n=5, scales=scales, ratios=ratios,
+        output_score=True)
+    assert rois.shape == (5, 5) and scores.shape == (5, 1)
+    s = scores.asnumpy().ravel()
+    assert (np.diff(s[s > 0]) <= 1e-6).all()  # sorted descending
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    """With zero offsets DCN must equal the plain convolution."""
+    R = np.random.RandomState(2)
+    x = R.randn(2, 4, 9, 9).astype("f")
+    w = R.randn(6, 4, 3, 3).astype("f")
+    off = np.zeros((2, 2 * 9, 7, 7), "f")
+    y_dcn = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        num_filter=6, no_bias=True)
+    y_ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           num_filter=6, no_bias=True)
+    np.testing.assert_allclose(y_dcn.asnumpy(), y_ref.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts_sampling():
+    """An integer offset of +1 row equals convolving the shifted image."""
+    R = np.random.RandomState(3)
+    x = R.randn(1, 2, 8, 8).astype("f")
+    w = R.randn(3, 2, 3, 3).astype("f")
+    off = np.zeros((1, 2 * 9, 6, 6), "f")
+    off[:, 0::2] = 1.0  # dy=+1 for every tap
+    y = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        num_filter=3, no_bias=True).asnumpy()
+    x_shift = np.zeros_like(x)
+    x_shift[:, :, :-1] = x[:, :, 1:]  # content moved up by 1
+    y_ref = nd.Convolution(nd.array(x_shift), nd.array(w), kernel=(3, 3),
+                           num_filter=3, no_bias=True).asnumpy()
+    # interior rows agree exactly (border rows differ: zero-fill vs clip)
+    np.testing.assert_allclose(y[:, :, :-1], y_ref[:, :, :-1],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_grads_flow():
+    from mxnet_tpu import autograd
+
+    R = np.random.RandomState(4)
+    x = nd.array(R.randn(1, 2, 6, 6).astype("f"))
+    off = nd.array((R.randn(1, 2 * 9, 4, 4) * 0.3).astype("f"))
+    w = nd.array(R.randn(2, 2, 3, 3).astype("f"))
+    for v in (x, off, w):
+        v.attach_grad()
+    with autograd.record():
+        y = nd.contrib.DeformableConvolution(x, off, w, kernel=(3, 3),
+                                             num_filter=2, no_bias=True)
+        y.sum().backward()
+    for v in (x, off, w):
+        g = v.grad.asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def _psroi_numpy_ref(data, rois, spatial_scale, output_dim, g, s):
+    """Mirror of the sampled-bilinear PSROIPooling semantics."""
+    n, ctot, hh, ww = data.shape
+    out = np.zeros((len(rois), output_dim, g, g), "f")
+    for r, roi in enumerate(rois):
+        b = int(roi[0])
+        x1, y1, x2, y2 = roi[1:] * spatial_scale
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bw, bh = rw / g, rh / g
+        for d in range(output_dim):
+            for gy in range(g):
+                for gx in range(g):
+                    c = d * g * g + gy * g + gx
+                    acc = 0.0
+                    for syi in range(s):
+                        for sxi in range(s):
+                            yy = min(max(y1 + (gy + (syi + .5) / s) * bh, 0),
+                                     hh - 1)
+                            xx = min(max(x1 + (gx + (sxi + .5) / s) * bw, 0),
+                                     ww - 1)
+                            y0, x0 = int(np.floor(yy)), int(np.floor(xx))
+                            y1i, x1i = min(y0 + 1, hh - 1), min(x0 + 1, ww - 1)
+                            wy, wx = yy - y0, xx - x0
+                            acc += (data[b, c, y0, x0] * (1 - wy) * (1 - wx) +
+                                    data[b, c, y1i, x0] * wy * (1 - wx) +
+                                    data[b, c, y0, x1i] * (1 - wy) * wx +
+                                    data[b, c, y1i, x1i] * wy * wx)
+                    out[r, d, gy, gx] = acc / (s * s)
+    return out
+
+
+def test_psroi_pooling_matches_numpy_reference():
+    R = np.random.RandomState(5)
+    g, dim, s = 3, 2, 2
+    data = R.randn(2, dim * g * g, 12, 12).astype("f")
+    rois = np.array([[0, 1.0, 1.0, 10.0, 10.0],
+                     [1, 2.0, 0.0, 8.0, 11.0]], "f")
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  spatial_scale=1.0, output_dim=dim,
+                                  pooled_size=g, group_size=g,
+                                  sample_per_part=s).asnumpy()
+    ref = _psroi_numpy_ref(data, rois, 1.0, dim, g, s)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)

@@ -160,3 +160,60 @@ def test_kvstore_sparse_update_on_kvstore():
     d = out.asnumpy()
     assert np.allclose(d[[1, 4]], 0.5)
     assert np.allclose(d[[0, 2, 3, 5]], 1.0)
+
+
+def test_sparse_dot_csr_dense_matches_numpy():
+    """SpMM path (reference: dot.cc FComputeEx csr kernels)."""
+    R = np.random.RandomState(0)
+    dense_lhs = R.randn(6, 8).astype("f")
+    dense_lhs[R.uniform(size=dense_lhs.shape) < 0.6] = 0.0
+    csr = mx.nd.sparse.csr_matrix(dense_lhs)
+    rhs = R.randn(8, 5).astype("f")
+    out = mx.nd.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), dense_lhs @ rhs,
+                               rtol=1e-5, atol=1e-6)
+    outT = mx.nd.dot(csr, mx.nd.array(R.randn(6, 4).astype("f")),
+                     transpose_a=True)
+    assert outT.shape == (8, 4)
+
+
+def test_sparse_dot_transpose_matches_numpy():
+    R = np.random.RandomState(1)
+    dense_lhs = R.randn(5, 7).astype("f")
+    dense_lhs[R.uniform(size=dense_lhs.shape) < 0.5] = 0.0
+    csr = mx.nd.sparse.csr_matrix(dense_lhs)
+    rhs = R.randn(5, 3).astype("f")
+    out = mx.nd.dot(csr, mx.nd.array(rhs), transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), dense_lhs.T @ rhs,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dense_dot_still_routes_through_registry():
+    a = mx.nd.ones((3, 4))
+    b = mx.nd.ones((4, 2))
+    np.testing.assert_allclose(mx.nd.dot(a, b).asnumpy(), np.full((3, 2), 4.0))
+
+
+def test_sparse_dot_shape_mismatch_raises():
+    csr = mx.nd.sparse.csr_matrix(np.eye(4, 6, dtype="f"))
+    with pytest.raises(mx.MXNetError):
+        mx.nd.dot(csr, mx.nd.ones((5, 2)))  # needs 6 rows
+
+
+def test_sparse_dot_numpy_rhs_and_out():
+    dense = np.eye(3, 4, dtype="f")
+    csr = mx.nd.sparse.csr_matrix(dense)
+    out = mx.nd.dot(csr, np.ones((4, 2), "f"))
+    np.testing.assert_allclose(out.asnumpy(), dense @ np.ones((4, 2)))
+    buf = mx.nd.zeros((3, 2))
+    r = mx.nd.dot(csr, mx.nd.ones((4, 2)), out=buf)
+    assert r is buf
+    np.testing.assert_allclose(buf.asnumpy(), dense @ np.ones((4, 2)))
+
+
+def test_csr_matmul_and_method_use_spmm():
+    dense = np.eye(3, 4, dtype="f")
+    csr = mx.nd.sparse.csr_matrix(dense)
+    rhs = mx.nd.ones((4, 2))
+    np.testing.assert_allclose((csr @ rhs).asnumpy(), dense @ np.ones((4, 2)))
+    np.testing.assert_allclose(csr.dot(rhs).asnumpy(), dense @ np.ones((4, 2)))
