@@ -74,9 +74,16 @@ def bench_resnet50(on_tpu):
     batch = 256 if on_tpu else 16
     size = 224 if on_tpu else 64
 
-    net = vision.resnet50_v1()
+    # NHWC: XLA:TPU tiles channel-last convs onto the MXU without the
+    # internal relayout transposes logical-NCHW convs pay (override with
+    # MXNET_BENCH_LAYOUT=NCHW to A/B the layouts on the chip)
+    import os
+
+    layout = os.environ.get("MXNET_BENCH_LAYOUT", "NHWC")
+    net = vision.resnet50_v1(layout=layout)
     net.initialize(ctx=mx.current_context())
-    net(mx.nd.zeros((1, 3, size, size)))  # settle deferred param shapes
+    dshape = (1, size, size, 3) if layout == "NHWC" else (1, 3, size, size)
+    net(mx.nd.zeros(dshape))  # settle deferred param shapes
 
     def loss_fn(logits, labels):
         import jax
@@ -89,7 +96,9 @@ def bench_resnet50(on_tpu):
                      optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
                      train_mode=True, dtype="bfloat16")
 
-    x = np.random.uniform(-1, 1, (batch, 3, size, size)).astype("float32")
+    xshape = (batch, size, size, 3) if layout == "NHWC" else \
+        (batch, 3, size, size)
+    x = np.random.uniform(-1, 1, xshape).astype("float32")
     y = np.random.randint(0, 1000, (batch,)).astype("int32")
     iters = 20 if on_tpu else 3
     dt = _time_steps(step, (x, y), warmup=2, iters=iters)

@@ -37,9 +37,18 @@ class _Conv(HybridBlock):
         if adj is not None:
             self._kwargs["adj"] = adj
         self._op_name = op_name
+        self._channel_last = layout is not None and layout.endswith("C")
         if op_name == "Convolution":
-            wshape = (channels, in_channels // groups) + tuple(kernel_size)
+            if self._channel_last:
+                # MXNet NHWC kernel convention: (num_filter, *k, C/group)
+                wshape = (channels,) + tuple(kernel_size) + \
+                    (in_channels // groups,)
+            else:
+                wshape = (channels, in_channels // groups) + tuple(kernel_size)
         else:  # Deconvolution: (in_channels, channels//groups, *k)
+            if self._channel_last:
+                raise ValueError("Deconvolution supports channel-first "
+                                 "layouts only (NCW/NCHW/NCDHW)")
             wshape = (in_channels, channels // groups) + tuple(kernel_size)
         self.weight = self.params.get("weight", shape=wshape,
                                       init=weight_initializer,
@@ -55,12 +64,14 @@ class _Conv(HybridBlock):
             self.register_child(self.act, "act")
 
     def infer_shape(self, x, *args):
-        c = x.shape[1]
+        g = self._kwargs["num_group"]
         w = list(self.weight.shape)
-        if self._op_name == "Convolution":
-            self.weight.shape = (w[0], c // self._kwargs["num_group"]) + tuple(w[2:])
+        if self._channel_last:
+            self.weight.shape = tuple(w[:-1]) + (x.shape[-1] // g,)
+        elif self._op_name == "Convolution":
+            self.weight.shape = (w[0], x.shape[1] // g) + tuple(w[2:])
         else:
-            self.weight.shape = (c, self._channels // self._kwargs["num_group"]) + tuple(w[2:])
+            self.weight.shape = (x.shape[1], self._channels // g) + tuple(w[2:])
 
     def hybrid_forward(self, F, x, weight, bias=None):
         op = getattr(F, self._op_name)
@@ -154,7 +165,8 @@ class _Pooling(HybridBlock):
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
-            "pooling_convention": "full" if ceil_mode else "valid"}
+            "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -167,7 +179,8 @@ class MaxPool1D(_Pooling):
                  ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 1),
                          _pair(strides, 1) if strides is not None else None,
-                         _pair(padding, 1), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 1), ceil_mode, False, "max", layout=layout,
+                         **kwargs)
 
 
 class MaxPool2D(_Pooling):
@@ -175,7 +188,8 @@ class MaxPool2D(_Pooling):
                  layout="NCHW", ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 2),
                          _pair(strides, 2) if strides is not None else None,
-                         _pair(padding, 2), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 2), ceil_mode, False, "max", layout=layout,
+                         **kwargs)
 
 
 class MaxPool3D(_Pooling):
@@ -183,7 +197,8 @@ class MaxPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 3),
                          _pair(strides, 3) if strides is not None else None,
-                         _pair(padding, 3), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 3), ceil_mode, False, "max", layout=layout,
+                         **kwargs)
 
 
 class AvgPool1D(_Pooling):
@@ -191,7 +206,7 @@ class AvgPool1D(_Pooling):
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_pair(pool_size, 1),
                          _pair(strides, 1) if strides is not None else None,
-                         _pair(padding, 1), ceil_mode, False, "avg",
+                         _pair(padding, 1), ceil_mode, False, "avg", layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
@@ -200,7 +215,7 @@ class AvgPool2D(_Pooling):
                  layout="NCHW", ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_pair(pool_size, 2),
                          _pair(strides, 2) if strides is not None else None,
-                         _pair(padding, 2), ceil_mode, False, "avg",
+                         _pair(padding, 2), ceil_mode, False, "avg", layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
@@ -209,38 +224,44 @@ class AvgPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_pair(pool_size, 3),
                          _pair(strides, 3) if strides is not None else None,
-                         _pair(padding, 3), ceil_mode, False, "avg",
+                         _pair(padding, 3), ceil_mode, False, "avg", layout=layout,
                          count_include_pad=count_include_pad, **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), False, True, "max", **kwargs)
+        super().__init__((1,), None, (0,), False, True, "max",
+                         layout=layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), False, True, "max", **kwargs)
+        super().__init__((1, 1), None, (0, 0), False, True, "max",
+                         layout=layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max", **kwargs)
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max",
+                         layout=layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), False, True, "avg", **kwargs)
+        super().__init__((1,), None, (0,), False, True, "avg",
+                         layout=layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), False, True, "avg", **kwargs)
+        super().__init__((1, 1), None, (0, 0), False, True, "avg",
+                         layout=layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg", **kwargs)
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg",
+                         layout=layout, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

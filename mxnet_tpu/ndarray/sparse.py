@@ -278,38 +278,56 @@ def add_rowsparse(a, b):
     return RowSparseNDArray.create(union, vals, a.shape, a._ctx)
 
 
-def dot_csr_dense(csr, dense, transpose_a=False):
-    """csr × dense matmul (reference: src/operator/tensor/dot.cc csr paths).
-    Stays compact: gather the needed dense rows per nonzero and segment-sum
-    — no dense materialization of the csr operand."""
+def _spmm(data, cols, indptr, n_rows, n_cols, dn, transpose_a):
+    """Pure-jax SpMM kernel: gather the needed dense rows per nonzero and
+    segment-sum — no dense materialization of the csr operand."""
     import jax
 
     jnp = _jnp()
-    dn = dense._get() if isinstance(dense, NDArray) else jnp.asarray(dense)
+    vec = dn.ndim == 1
+    dn2 = dn[:, None] if vec else dn  # 1-D rhs: matvec via a (k, 1) matmul
+    nnz = data.shape[0]
+    counts = jnp.diff(indptr)
+    rows = jnp.repeat(jnp.arange(n_rows), counts, total_repeat_length=nnz)
+    if not transpose_a:
+        # out[r] += data * dense[col]
+        contrib = data[:, None] * dn2[cols]
+        out = jax.ops.segment_sum(contrib, rows, num_segments=n_rows)
+    else:
+        # out[col] += data * dense[row]  (shape (m, k))
+        contrib = data[:, None] * dn2[rows]
+        out = jax.ops.segment_sum(contrib, cols, num_segments=n_cols)
+    return out[:, 0] if vec else out
+
+
+def dot_csr_dense(csr, dense, transpose_a=False):
+    """csr × dense matmul (reference: src/operator/tensor/dot.cc csr paths).
+
+    Autograd: routed through apply_fn so the gradient flows to the dense
+    operand (grad wrt the dense rhs is csrᵀ × out_grad — jax derives it from
+    the same segment-sum program).  Gradient wrt the csr *values* is not
+    supported, matching the reference csr dot which treats the sparse
+    operand as data."""
+    from .ndarray import apply_fn
+
+    if not isinstance(dense, NDArray) and not hasattr(dense, "shape"):
+        dense = _jnp().asarray(dense)
     want = csr._csr_shape[0] if transpose_a else csr._csr_shape[1]
-    if dn.shape[0] != want:
+    if dense.shape[0] != want:
         # jax clamps out-of-bounds gathers, which would return silently
         # wrong values — fail like the dense path does
         raise MXNetError(
             f"dot: csr shape {csr._csr_shape} (transpose_a={transpose_a}) "
-            f"incompatible with rhs shape {tuple(dn.shape)}")
+            f"incompatible with rhs shape {tuple(dense.shape)}")
     data = csr._csr_data
     cols = csr._csr_indices
     indptr = csr._csr_indptr
-    n = csr._csr_shape[0]
-    nnz = data.shape[0]
-    counts = jnp.diff(indptr)
-    rows = jnp.repeat(jnp.arange(n), counts, total_repeat_length=nnz)
-    if not transpose_a:
-        # out[r] += data * dense[col]
-        contrib = data[:, None] * dn[cols]
-        out = jax.ops.segment_sum(contrib, rows, num_segments=n)
-    else:
-        # out[col] += data * dense[row]  (shape (m, k))
-        contrib = data[:, None] * dn[rows]
-        out = jax.ops.segment_sum(contrib, cols,
-                                  num_segments=csr._csr_shape[1])
-    return NDArray._from_jax(out, csr._ctx)
+    n_rows, n_cols = csr._csr_shape
+
+    def fn(dn):
+        return _spmm(data, cols, indptr, n_rows, n_cols, dn, transpose_a)
+
+    return apply_fn(fn, [dense], name="dot_csr_dense", ctx=csr._ctx)
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):

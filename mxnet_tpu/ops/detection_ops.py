@@ -455,7 +455,7 @@ def _bbox_transform_inv(anchors, deltas):
 
 
 @register("_contrib_Proposal", aliases=("Proposal", "proposal"),
-          differentiable=False)
+          differentiable=False, nout="dynamic")
 def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
@@ -497,22 +497,47 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         k = min(rpn_pre_nms_top_n, fg.shape[0])
         top_scores, top_idx = jax.lax.top_k(fg, k)
         top_boxes = boxes[top_idx]
-        rows = jnp.concatenate([top_scores[:, None], top_boxes], axis=1)
-        kept = box_nms(rows, overlap_thresh=threshold, valid_thresh=0.0,
-                       coord_start=1, score_index=0, id_index=-1)
-        kept_scores = kept[:, 0]
-        order = jnp.argsort(-kept_scores)
-        kept = kept[order][:rpn_post_nms_top_n]
-        kept_scores = kept[:, 0]
+        # greedy NMS bounded by post_nms_top_n picks: each step selects the
+        # best remaining box and suppresses its >threshold-IOU neighbours —
+        # O(K·post_n) compute, O(K) memory per image (the full box_nms op's
+        # K×K IOU matrix would be ~140 MB per image at the 6000 default)
+        ws_t = top_boxes[:, 2] - top_boxes[:, 0] + 1
+        hs_t = top_boxes[:, 3] - top_boxes[:, 1] + 1
+        areas = ws_t * hs_t
+        n_out = min(rpn_post_nms_top_n, k)
+
+        def nms_body(i, carry):
+            live, out_idx, out_val = carry
+            j = jnp.argmax(live)
+            sj = live[j]
+            out_idx = out_idx.at[i].set(j)
+            out_val = out_val.at[i].set(sj)
+            bj = top_boxes[j]
+            ix1 = jnp.maximum(top_boxes[:, 0], bj[0])
+            iy1 = jnp.maximum(top_boxes[:, 1], bj[1])
+            ix2 = jnp.minimum(top_boxes[:, 2], bj[2])
+            iy2 = jnp.minimum(top_boxes[:, 3], bj[3])
+            inter = (jnp.maximum(ix2 - ix1 + 1, 0.0)
+                     * jnp.maximum(iy2 - iy1 + 1, 0.0))
+            iou = inter / (areas + areas[j] - inter)
+            live = jnp.where(iou > threshold, -jnp.inf, live)
+            # threshold >= 1 ('NMS off') must still retire the picked box
+            live = live.at[j].set(-jnp.inf)
+            return live, out_idx, out_val
+
+        _, keep_idx, keep_scores = jax.lax.fori_loop(
+            0, n_out, nms_body,
+            (top_scores, jnp.zeros((n_out,), "int32"), jnp.zeros((n_out,))))
+        kept_boxes = top_boxes[keep_idx]
         # pad suppressed slots with the best box (reference pads output)
-        best = kept[0]
-        valid = kept_scores > 0
-        out_boxes = jnp.where(valid[:, None], kept[:, 1:5], best[1:5])
-        out_scores = jnp.where(valid, kept_scores, 0.0)
-        pad = rpn_post_nms_top_n - out_boxes.shape[0]
+        best = kept_boxes[0]
+        valid = keep_scores > 0
+        out_boxes = jnp.where(valid[:, None], kept_boxes, best)
+        out_scores = jnp.where(valid, keep_scores, 0.0)
+        pad = rpn_post_nms_top_n - n_out
         if pad > 0:
             out_boxes = jnp.concatenate(
-                [out_boxes, jnp.tile(best[1:5], (pad, 1))])
+                [out_boxes, jnp.tile(best, (pad, 1))])
             out_scores = jnp.concatenate([out_scores, jnp.zeros(pad)])
         return out_boxes, out_scores
 

@@ -63,13 +63,19 @@ def fully_connected(x, weight, *maybe_bias, num_hidden=None, no_bias=False,
 # Convolution / Deconvolution (reference: src/operator/nn/convolution.cc)
 # ==========================================================================
 def _conv_dimnums(ndim, layout):
-    if ndim == 3:  # NCW
+    """Channel-last weights use MXNet's NHWC kernel convention
+    (num_filter, *spatial, C/group) — OHWI-style dimension numbers."""
+    if ndim == 3:
+        if layout == "NWC":
+            return ("NHC", "OHI", "NHC")
         return ("NCH", "OIH", "NCH")
     if ndim == 4:
-        if layout in (None, "NCHW"):
-            return ("NCHW", "OIHW", "NCHW")
-        return ("NHWC", "HWIO", "NHWC")
+        if layout == "NHWC":
+            return ("NHWC", "OHWI", "NHWC")
+        return ("NCHW", "OIHW", "NCHW")
     if ndim == 5:
+        if layout == "NDHWC":
+            return ("NDHWC", "ODHWI", "NDHWC")
         return ("NCDHW", "OIDHW", "NCDHW")
     raise ValueError(f"conv input ndim {ndim} unsupported")
 
@@ -93,7 +99,10 @@ def convolution(x, weight, *maybe_bias, kernel=None, stride=None, dilate=None,
         preferred_element_type=None)
     if not no_bias and maybe_bias:
         b = maybe_bias[0]
-        y = y + b.reshape((1, -1) + (1,) * nd)
+        if layout is not None and layout.endswith("C"):
+            y = y + b  # channel-last: broadcasts over the trailing dim
+        else:
+            y = y + b.reshape((1, -1) + (1,) * nd)
     return y
 
 
@@ -133,25 +142,33 @@ def pooling(x, kernel=None, pool_type="max", stride=None, pad=None,
     lax = _lax()
     jnp = _jnp()
     nd = x.ndim - 2
+    # channel-last layouts (NWC/NHWC/NDHWC): spatial dims are 1..nd
+    cl = layout is not None and layout.endswith("C")
     if global_pool:
-        axes = tuple(range(2, x.ndim))
+        axes = tuple(range(1, x.ndim - 1)) if cl else tuple(range(2, x.ndim))
         if pool_type == "max":
             return jnp.max(x, axis=axes, keepdims=True)
         return jnp.mean(x, axis=axes, keepdims=True)
     k = _tup(kernel, nd)
     s = _tup(stride if stride is not None else 1, nd)
     p = _tup(pad or 0, nd)
-    window = (1, 1) + k
-    strides = (1, 1) + s
-    padding = ((0, 0), (0, 0)) + tuple((pp, pp) for pp in p)
+    sp0 = 1 if cl else 2  # first spatial dim
+    pads = [(pp, pp) for pp in p]
     if pooling_convention == "full":
         # ceil-mode: extend padding on the high side so ceil division is covered
-        extra = []
         for i in range(nd):
-            in_sz = x.shape[2 + i] + 2 * p[i]
+            in_sz = x.shape[sp0 + i] + 2 * p[i]
             rem = (in_sz - k[i]) % s[i]
-            extra.append(0 if rem == 0 else s[i] - rem)
-        padding = ((0, 0), (0, 0)) + tuple((p[i], p[i] + extra[i]) for i in range(nd))
+            if rem:
+                pads[i] = (p[i], p[i] + s[i] - rem)
+    if cl:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        padding = ((0, 0),) + tuple(pads) + ((0, 0),)
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        padding = ((0, 0), (0, 0)) + tuple(pads)
     if pool_type == "max":
         init = -_np.inf
         y = lax.reduce_window(x, init, lax.max, window, strides, padding)
@@ -293,6 +310,7 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
     """
     jnp = _jnp()
     g = jnp.ones_like(gamma) if fix_gamma else gamma
+    axis = axis % x.ndim  # accept axis=-1 (channel-last layouts)
     axes = tuple(i for i in range(x.ndim) if i != axis)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]

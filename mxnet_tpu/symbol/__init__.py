@@ -9,5 +9,8 @@ from .symbol import (Symbol, var, Variable, Group, load, load_json, constant,
 
 populate_namespace(globals())
 
+# sub-namespace (reference: python/mxnet/symbol/contrib.py)
+from . import contrib  # noqa: E402,F401
+
 zeros = globals().get("zeros")
 ones = globals().get("ones")

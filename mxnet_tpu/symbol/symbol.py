@@ -103,6 +103,19 @@ _DYNAMIC_NOUT = {
 }
 
 
+def _attr_true(v):
+    """Symbol attrs may arrive as python bools or JSON strings."""
+    return v in (True, "True", "true", "1", 1)
+
+
+def _proposal_nout(attrs, nin):
+    return 2 if _attr_true(attrs.get("output_score")) else 1
+
+
+for _k in ("_contrib_Proposal", "Proposal", "proposal"):
+    _DYNAMIC_NOUT[_k] = _proposal_nout
+
+
 class _NameManager(threading.local):
     def __init__(self):
         self.counters = {}

@@ -141,3 +141,22 @@ def test_psroi_pooling_matches_numpy_reference():
                                   sample_per_part=s).asnumpy()
     ref = _psroi_numpy_ref(data, rois, 1.0, dim, g, s)
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_proposal_output_score_symbolic():
+    """Proposal with output_score=True has 2 symbolic heads (dynamic nout)."""
+    cls = mx.sym.var("cls")
+    bbox = mx.sym.var("bbox")
+    info = mx.sym.var("info")
+    out = mx.sym.contrib.Proposal(cls, bbox, info, rpn_pre_nms_top_n=40,
+                                  rpn_post_nms_top_n=8, scales=(4, 8, 16),
+                                  ratios=(1.0,), output_score=True)
+    assert len(out.list_outputs()) == 2
+    rng = np.random.RandomState(3)
+    ex = out.bind(mx.cpu(), {
+        "cls": mx.nd.array(rng.uniform(0, 1, (1, 6, 4, 4)).astype("f")),
+        "bbox": mx.nd.array(rng.randn(1, 12, 4, 4).astype("f") * 0.1),
+        "info": mx.nd.array([[64.0, 64.0, 1.0]])})
+    rois, scores = ex.forward()
+    assert rois.shape == (8, 5)
+    assert scores.shape == (8, 1)

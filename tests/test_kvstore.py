@@ -91,3 +91,43 @@ def test_type_strings():
               'dist_async', 'dist_tpu_sync'):
         kv = kvstore.create(t)
         assert kv.type == t
+
+
+def test_gradient_compression_training_converges():
+    """End-to-end: 2-bit-compressed training still converges — the
+    error-feedback residual preserves the gradient signal over steps
+    (reference: tests/python/unittest/test_kvstore.py compressed training,
+    src/kvstore/gradient_compression.cc semantics)."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(7)
+    X = rs.randn(64, 4).astype("f")
+    w_true = np.array([[1.5], [-2.0], [0.5], [3.0]], "f")
+    Y = X @ w_true
+
+    def run(compression):
+        net = nn.Dense(1, use_bias=False)
+        net.initialize(mx.init.Zero())
+        net(mx.nd.array(X[:1]))
+        # lr*threshold is the ternary pulse size; keep it small enough that
+        # the delta-sigma loop is in its stable regime (verified against a
+        # pure-numpy oracle of the same error-feedback dynamics)
+        trainer = gluon.Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.01},
+            kvstore="device", compression_params=compression)
+        losses = []
+        for _ in range(400):
+            x, y = mx.nd.array(X), mx.nd.array(Y)
+            with autograd.record():
+                loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            trainer.step(1)
+            losses.append(float(loss.asnumpy()))
+        return losses
+
+    losses = run({"type": "2bit", "threshold": 0.5})
+    # compressed training must make real progress (not necessarily match
+    # the uncompressed trajectory step for step)
+    assert losses[-1] < 0.2 * losses[0], (losses[0], losses[-1])
+    assert all(np.isfinite(l) for l in losses)

@@ -49,3 +49,46 @@ def test_model_save_load_roundtrip(tmp_path):
     net2 = vision.get_model("mobilenet0_25", classes=5)
     net2.load_parameters(p)
     assert np.allclose(y0.asnumpy(), net2(x).asnumpy(), atol=1e-5)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """layout='NHWC' (TPU-preferred channel-last) computes the same function
+    as the reference NCHW layout: transpose inputs + remap conv weights
+    OIHW->OHWI and outputs must agree."""
+    net1 = vision.resnet18_v1()
+    net1.initialize()
+    x = mx.nd.array(np.random.RandomState(0).uniform(
+        -1, 1, (2, 3, 32, 32)).astype("f"))
+    y1 = net1(x)
+
+    net2 = vision.resnet18_v1(layout="NHWC")
+    net2.initialize()
+    xt = mx.nd.transpose(x, (0, 2, 3, 1))
+    net2(xt)  # settle deferred shapes
+    p1, p2 = net1.collect_params(), net2.collect_params()
+    for (k1, v1), (k2, v2) in zip(p1.items(), p2.items()):
+        a = v1.data().asnumpy()
+        if a.ndim == 4:  # conv weight OIHW -> OHWI
+            a = a.transpose(0, 2, 3, 1)
+        assert a.shape == tuple(v2.shape), (k1, k2, a.shape, v2.shape)
+        v2.set_data(mx.nd.array(a))
+    y2 = net2(xt)
+    assert np.allclose(y1.asnumpy(), y2.asnumpy(), atol=1e-3), \
+        np.abs(y1.asnumpy() - y2.asnumpy()).max()
+
+
+def test_resnet_nhwc_trains():
+    """NHWC network runs fwd+bwd under hybridize (the bench path)."""
+    from mxnet_tpu import autograd
+
+    net = vision.resnet18_v1(layout="NHWC", thumbnail=True)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((2, 32, 32, 3))
+    with autograd.record():
+        y = net(x)
+        loss = y.sum()
+    loss.backward()
+    w = [p for p in net.collect_params().values()
+         if p.grad_req != "null"][0]
+    assert np.isfinite(w.grad().asnumpy()).all()

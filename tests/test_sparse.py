@@ -217,3 +217,36 @@ def test_csr_matmul_and_method_use_spmm():
     rhs = mx.nd.ones((4, 2))
     np.testing.assert_allclose((csr @ rhs).asnumpy(), dense @ np.ones((4, 2)))
     np.testing.assert_allclose(csr.dot(rhs).asnumpy(), dense @ np.ones((4, 2)))
+
+
+def test_sparse_dot_vector_rhs():
+    """csr × 1-D vector returns a vector (reference: dot csr/dense matvec)."""
+    R = np.random.RandomState(11)
+    dense = R.randn(5, 6).astype("f")
+    dense[dense < 0.5] = 0
+    csr = mx.nd.array(dense).tostype("csr")
+    v = R.randn(6).astype("f")
+    out = mx.nd.dot(csr, mx.nd.array(v))
+    assert out.shape == (5,)
+    assert np.allclose(out.asnumpy(), dense @ v, atol=1e-5)
+    outT = mx.nd.dot(csr, mx.nd.array(R.randn(5).astype("f")),
+                     transpose_a=True)
+    assert outT.shape == (6,)
+
+
+def test_sparse_dot_gradient_to_dense_operand():
+    """csr×dense dot under autograd.record flows the gradient to the dense
+    operand (reference: dot backward dns grad = csrᵀ × ograd)."""
+    from mxnet_tpu import autograd
+
+    R = np.random.RandomState(12)
+    dense = R.randn(4, 5).astype("f")
+    dense[np.abs(dense) < 0.7] = 0
+    csr = mx.nd.array(dense).tostype("csr")
+    w = mx.nd.array(R.randn(5, 3).astype("f"))
+    w.attach_grad()
+    with autograd.record():
+        loss = mx.nd.dot(csr, w).sum()
+    loss.backward()
+    expect = dense.T @ np.ones((4, 3), "f")
+    assert np.allclose(w.grad.asnumpy(), expect, atol=1e-5)

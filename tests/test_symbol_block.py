@@ -166,3 +166,35 @@ def test_symbolblock_aux_state_updates_under_trainstep(tmp_path):
     after = np.asarray(step.params[aux_name])
     assert not np.allclose(before, after), \
         "moving stats must thread through the jit state outputs"
+
+
+def test_symbolblock_arg_named_like_aux(tmp_path):
+    """A trainable arg whose NAME ends in an aux-style suffix must still be
+    classified as an arg: arg-vs-aux is positional (list_auxiliary_states),
+    never name matching (reference: aux is a property of the op's state
+    slots, src/nnvm/legacy_op_util.cc)."""
+
+    class Odd(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.fake_running_var = self.params.get(
+                "fake_running_var", shape=(8, 4))
+
+        def hybrid_forward(self, F, x, fake_running_var):
+            return F.dot(x, fake_running_var)
+
+    net = Odd()
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(3).randn(2, 8).astype("f"))
+    y0 = net(x)
+    prefix = str(tmp_path / "odd")
+    net.export(prefix, 0, x)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
+    assert any(k.endswith("fake_running_var") for k in arg), arg.keys()
+    assert not aux, aux.keys()
+    blk = gluon.SymbolBlock.imports(f"{prefix}-symbol.json", ["data"],
+                                    f"{prefix}-0000.params")
+    pname = [n for n in blk._sym_param_names
+             if n.endswith("fake_running_var")][0]
+    assert blk.params.get(pname).grad_req == "write"
+    assert np.allclose(y0.asnumpy(), blk(x).asnumpy(), atol=1e-5)
