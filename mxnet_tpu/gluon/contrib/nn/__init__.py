@@ -41,10 +41,20 @@ class SyncBatchNorm(BatchNorm):
     """Cross-device BatchNorm (reference: src/operator/contrib/
     sync_batch_norm.cc — the only activation-space collective in MXNet 1.x).
 
-    TPU-native: under a sharded jit step the batch axis is already global, so
-    plain BatchNorm statistics computed inside shard_map with a psum ARE
-    sync-BN; in the imperative single-process path this degenerates to
-    BatchNorm (same as the reference with ndev=1).
+    TPU-native, two execution regimes:
+
+    - **Sharded jit (TrainStep/hybridize over a mesh)**: the batch axis is
+      global, so plain BatchNorm statistics reduce over the WHOLE global
+      batch — GSPMD inserts the cross-device collective for the mean/var
+      reductions (forward and backward).  Sync-BN is exact here for free.
+    - **Eager multi-process (dist_tpu_sync-style jobs)**: the forward
+      statistics are allreduced across processes (sum/sumsq/count), so the
+      normalization and the running stats use the GLOBAL batch — the
+      small-per-device-batch convergence story sync-BN exists for.  The
+      backward treats the synced statistics as constants (the reference
+      reduces the statistic gradients in a second collective; the jit path
+      above gets those terms exactly, this eager path approximates them
+      locally).
     """
 
     def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
@@ -60,3 +70,56 @@ class SyncBatchNorm(BatchNorm):
                          running_mean_initializer=running_mean_initializer,
                          running_variance_initializer=running_variance_initializer,
                          in_channels=in_channels, **kwargs)
+        # reference arg: ndev defaults to "all" — here the process count
+        self._num_devices = num_devices
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        import jax
+
+        from ...nn.basic_layers import autograd
+
+        training = autograd.is_training() and not self._use_global_stats
+        xv = x._get() if hasattr(x, "_get") else x
+        eager = not isinstance(xv, jax.core.Tracer)
+        nproc = jax.process_count()
+        if not (training and eager and nproc > 1):
+            # single process (reference ndev=1) or under jit (GSPMD syncs)
+            return BatchNorm.hybrid_forward(self, F, x, gamma, beta,
+                                            running_mean, running_var)
+
+        import numpy as np
+
+        from ....parallel.collectives import allreduce_hosts
+
+        axis = self._axis % len(x.shape)
+        axes = tuple(i for i in range(len(x.shape)) if i != axis)
+        c = x.shape[axis]
+        local_count = 1
+        for i in axes:
+            local_count *= x.shape[i]
+        # forward-exact global statistics: one allreduce of [sum, sumsq, n]
+        xs = x.asnumpy().astype("float64")
+        stats = np.concatenate([xs.sum(axis=axes).ravel(),
+                                (xs * xs).sum(axis=axes).ravel(),
+                                [float(local_count)]])
+        import jax.numpy as jnp
+
+        g = np.asarray(allreduce_hosts(jnp.asarray(stats, jnp.float32)))
+        n = g[-1]
+        mean = g[:c] / n
+        var = g[c:2 * c] / n - mean * mean
+        bshape = [1] * len(x.shape)
+        bshape[axis] = c
+        mean_nd = F.array(mean.reshape(bshape).astype("float32"))
+        std_nd = F.array(
+            (1.0 / np.sqrt(var + self._epsilon))
+            .reshape(bshape).astype("float32"))
+        gam = gamma if self._scale else F.ones_like(gamma)
+        out = (x - mean_nd) * std_nd * gam.reshape(bshape) \
+            + beta.reshape(bshape)
+        m = self._momentum
+        new_mean = running_mean * m + F.array(mean.astype("float32")) * (1 - m)
+        new_var = running_var * m + F.array(var.astype("float32")) * (1 - m)
+        self._update_running_state(self.running_mean, new_mean)
+        self._update_running_state(self.running_var, new_var)
+        return out

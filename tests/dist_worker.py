@@ -56,6 +56,28 @@ rout = mx.nd.zeros((2, 4))
 kv.row_sparse_pull(7, out=rout, row_ids=rows)
 np.testing.assert_allclose(rout.asnumpy(), expect_w2[[0, 2]], rtol=1e-5)
 
+# 4. SyncBatchNorm: eager cross-process batch statistics (reference:
+# src/operator/contrib/sync_batch_norm.cc forward allreduce)
+from mxnet_tpu import autograd, gluon
+
+sbn = gluon.contrib.nn.SyncBatchNorm(in_channels=3)
+sbn.initialize()
+xloc = np.random.RandomState(100 + rank).randn(4, 3, 2, 2).astype("f")
+with autograd.record():
+    y = sbn(mx.nd.array(xloc))
+all_x = np.concatenate([
+    np.random.RandomState(100 + r).randn(4, 3, 2, 2).astype("f")
+    for r in range(n)])
+gm = all_x.mean((0, 2, 3))
+gv = all_x.var((0, 2, 3))
+expect_y = (xloc - gm[None, :, None, None]) / \
+    np.sqrt(gv[None, :, None, None] + 1e-5)
+np.testing.assert_allclose(y.asnumpy(), expect_y, rtol=1e-3, atol=1e-3)
+np.testing.assert_allclose(sbn.running_mean.data().asnumpy(), 0.1 * gm,
+                           rtol=1e-3, atol=1e-4)
+np.testing.assert_allclose(sbn.running_var.data().asnumpy(),
+                           0.9 * 1.0 + 0.1 * gv, rtol=1e-3, atol=1e-4)
+
 marker = os.environ.get("DIST_TEST_MARKER")
 if marker:
     with open(f"{marker}.{rank}", "w") as f:

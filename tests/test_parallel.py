@@ -167,3 +167,25 @@ def test_trainstep_mesh_does_not_donate_net_buffers():
     step(np.ones((2, 3), "f"), np.zeros((2,), "i")).block_until_ready()
     out = net(mx.nd.ones((2, 3)))  # must not raise "buffer deleted/donated"
     assert out.shape == (2, 4)
+
+
+def test_sync_batch_norm_single_process_matches_bn():
+    """ndev=1: SyncBatchNorm degenerates to plain BatchNorm (reference
+    sync_batch_norm.cc with ndev=1)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    x = np.random.RandomState(0).randn(4, 3, 5, 5).astype("f")
+    sbn = gluon.contrib.nn.SyncBatchNorm(in_channels=3)
+    bn = gluon.nn.BatchNorm(in_channels=3)
+    sbn.initialize()
+    bn.initialize()
+    with autograd.record():
+        y1 = sbn(mx.nd.array(x))
+    with autograd.record():
+        y2 = bn(mx.nd.array(x))
+    assert np.allclose(y1.asnumpy(), y2.asnumpy(), atol=1e-5)
+    assert np.allclose(sbn.running_mean.data().asnumpy(),
+                       bn.running_mean.data().asnumpy(), atol=1e-6)
