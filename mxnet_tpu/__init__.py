@@ -69,6 +69,10 @@ from .util import test_utils
 from . import runtime
 from . import callback
 from . import monitor
+from . import subgraph
+from . import env
+
+env.apply_env()
 from . import parallel
 from . import contrib
 

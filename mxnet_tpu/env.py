@@ -1,0 +1,130 @@
+"""MXNET_* environment-variable behavior layer.
+
+Reference: ``docs/static_site/src/pages/api/faq/env_var.md`` + the scattered
+``dmlc::GetEnv`` reads in src/ (SURVEY.md §6.6 "Config/flags").  The
+reference configures its engine/executor/kvstore through ~60 MXNET_* vars;
+the TPU build keeps the same names for the vars whose concern still exists,
+maps each to the XLA-native mechanism, and documents the ones XLA subsumes
+instead of silently ignoring them.
+
+Wired vars (read at ``import mxnet_tpu``):
+
+- ``MXNET_ENGINE_TYPE``: ``NaiveEngine`` = eager op-by-op determinism
+  switch (jax_disable_jit) — see :mod:`mxnet_tpu.engine`.
+- ``MXNET_TPU_MATMUL_PRECISION``: fp32 matmul/conv MXU precision policy —
+  see :mod:`mxnet_tpu.engine`.
+- ``MXNET_SEED``: seeds the global RNG (≙ reference mx.random.seed at
+  process start).
+- ``MXNET_CPU_WORKER_NTHREADS``: default decode/augment pool width for
+  ImageRecordIter and the Gluon DataLoader prefetcher (≙ the reference's
+  OMP worker pool size).
+- ``MXNET_PROFILER_AUTOSTART``: start the profiler with profile_all=True
+  at import (≙ reference profiler autostart).
+- ``MXNET_KVSTORE_BIGARRAY_BOUND``: size threshold (elements) above which
+  dist kvstore values get their own collective rather than riding a fused
+  bucket.
+- ``MXNET_COORDINATOR_ADDRESS``: jax.distributed coordinator override
+  (read in parallel.distributed.init).
+- ``MXNET_TEST_TPU``: selects the real-chip test lane (tests/conftest.py).
+
+Accepted-but-subsumed (XLA owns the concern; reads return the default and
+``describe()`` says why):
+
+- ``MXNET_EXEC_BULK_EXEC_TRAIN`` / ``MXNET_EXEC_BULK_EXEC_INFERENCE`` /
+  ``MXNET_EXEC_ENABLE_INPLACE``: operator bulking/fusion/in-place planning
+  is XLA's fusion + buffer-assignment pass.
+- ``MXNET_ENFORCE_DETERMINISM``: XLA:TPU kernels are deterministic by
+  construction (no atomics-race reductions); the switch therefore asserts
+  rather than changes behavior.
+- ``MXNET_GPU_MEM_POOL_RESERVE``: HBM pooling is the XLA allocator's
+  (``XLA_PYTHON_CLIENT_MEM_FRACTION`` controls the reservation).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_int", "get_str", "get_bool", "cpu_worker_nthreads",
+           "kvstore_bigarray_bound", "describe", "apply_env"]
+
+_SUBSUMED = {
+    "MXNET_EXEC_BULK_EXEC_TRAIN": "XLA fusion owns operator bulking",
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": "XLA fusion owns operator bulking",
+    "MXNET_EXEC_ENABLE_INPLACE": "XLA buffer assignment owns in-place",
+    "MXNET_ENFORCE_DETERMINISM": "XLA:TPU kernels are deterministic",
+    "MXNET_GPU_MEM_POOL_RESERVE":
+        "XLA allocator owns HBM pooling (XLA_PYTHON_CLIENT_MEM_FRACTION)",
+}
+
+
+def get_str(name, default=None):
+    return os.environ.get(name, default)
+
+
+def get_int(name, default=0):
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"{name}={v!r} is not an integer; using {default}",
+                      stacklevel=2)
+        return default
+
+
+def get_bool(name, default=False):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def cpu_worker_nthreads():
+    """Default worker-pool width for decode/augment stages
+    (reference: MXNET_CPU_WORKER_NTHREADS, default 1 there — default 4
+    here since the TPU input pipeline assumes a threaded decode stage)."""
+    return max(1, get_int("MXNET_CPU_WORKER_NTHREADS", 4))
+
+
+def kvstore_bigarray_bound():
+    """Elements above which a kvstore value gets its own collective
+    (reference: MXNET_KVSTORE_BIGARRAY_BOUND, default 1e6)."""
+    return get_int("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000)
+
+
+def describe():
+    """One line per known var: current value and what it maps to."""
+    lines = []
+    wired = [
+        ("MXNET_ENGINE_TYPE", "determinism switch (engine.set_engine_type)"),
+        ("MXNET_TPU_MATMUL_PRECISION",
+         "fp32 MXU precision (engine.set_matmul_precision)"),
+        ("MXNET_SEED", "global RNG seed at import (random.seed)"),
+        ("MXNET_CPU_WORKER_NTHREADS", "decode/augment pool width"),
+        ("MXNET_PROFILER_AUTOSTART", "start profiler at import"),
+        ("MXNET_KVSTORE_BIGARRAY_BOUND", "dist kvstore bucket threshold"),
+        ("MXNET_COORDINATOR_ADDRESS", "jax.distributed coordinator"),
+        ("MXNET_TEST_TPU", "real-chip test lane"),
+    ]
+    for name, what in wired:
+        lines.append(f"{name}={os.environ.get(name, '<unset>')} — {what}")
+    for name, why in _SUBSUMED.items():
+        lines.append(f"{name}={os.environ.get(name, '<unset>')} — subsumed: "
+                     f"{why}")
+    return "\n".join(lines)
+
+
+def apply_env():
+    """Apply import-time vars (called once from mxnet_tpu/__init__)."""
+    seed = os.environ.get("MXNET_SEED")
+    if seed:
+        from . import random as _random
+
+        _random.seed(int(seed))
+    if get_bool("MXNET_PROFILER_AUTOSTART"):
+        from . import profiler
+
+        profiler.set_config(profile_all=True)
+        profiler.start()

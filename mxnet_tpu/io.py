@@ -360,7 +360,7 @@ class ImageRecordIter(DataIter):
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, resize=-1, num_parts=1, part_index=0, seed=0,
-                 round_batch=True, prefetch_buffer=4, preprocess_threads=4,
+                 round_batch=True, prefetch_buffer=4, preprocess_threads=None,
                  data_name="data", label_name="softmax_label", **kwargs):
         super().__init__(batch_size)
         from .native import NativeRecordReader
@@ -377,6 +377,10 @@ class ImageRecordIter(DataIter):
         self.round_batch = round_batch
         self._seed = seed
         self._epoch = 0
+        if preprocess_threads is None:
+            from . import env as _env
+
+            preprocess_threads = _env.cpu_worker_nthreads()
         self._n_threads = max(int(preprocess_threads), 1)
         self._depth = prefetch_buffer
         self._reader = NativeRecordReader(

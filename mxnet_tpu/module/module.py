@@ -97,8 +97,16 @@ class Module(BaseModule):
                 req[n] = "null"
             else:
                 req[n] = grad_req if for_training else "null"
+        from .. import subgraph as _subgraph
+
+        # MXNET_SUBGRAPH_BACKEND partitions at bind time (reference:
+        # executor attach-time subgraph rewrite).  Only the executor sees
+        # the fused graph: module.symbol / save_checkpoint keep the user's
+        # original Symbol (the reference never mutates it either)
+        bind_symbol = _subgraph.apply_env_backend(self._symbol)
+        self._bind_symbol = bind_symbol
         shared_exec = shared_module._exec if shared_module is not None else None
-        self._exec = self._symbol.simple_bind(
+        self._exec = bind_symbol.simple_bind(
             self._context, grad_req=req, shared_exec=shared_exec, **shapes)
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:

@@ -1,0 +1,232 @@
+"""Subgraph / graph-partitioning API.
+
+Reference: ``src/operator/subgraph/`` + ``Symbol.optimize_for`` +
+``MXNET_SUBGRAPH_BACKEND`` (SURVEY.md §3.2 "Subgraph/partitioning API").
+The reference lets a backend (MKLDNN, TensorRT, …) register a
+SubgraphProperty that pattern-matches regions of the NNVM graph and
+replaces them with fused backend nodes; users trigger it with
+``sym.optimize_for(backend)`` or globally via the env var at bind time.
+
+TPU-native scope: XLA already owns low-level fusion, so the interesting
+passes here operate at the *operator graph* level — collapsing op chains
+into single registered ops (fewer dispatches in the eager Executor, one
+tape entry under autograd) and giving users the same extension point the
+reference exposes: register a backend, attach passes, call
+``optimize_for``.  Passes are pure ``Symbol -> Symbol`` functions over a
+cloned graph (the input Symbol is never mutated).
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+from .ops.registry import OP_TABLE, register
+from .symbol.symbol import Symbol, _Node, _topo
+
+__all__ = ["register_backend", "register_pass", "list_backends",
+           "optimize_for", "clone", "fuse_linear_chain"]
+
+_BACKENDS = {}
+
+
+def register_backend(name, passes=None):
+    """Register (or extend) a partitioning backend — ≙ the reference's
+    SubgraphProperty registration (subgraph_property.h)."""
+    _BACKENDS.setdefault(name, [])
+    if passes:
+        _BACKENDS[name].extend(passes)
+    return _BACKENDS[name]
+
+
+def register_pass(backend):
+    """Decorator: append a ``Symbol -> Symbol`` pass to a backend."""
+
+    def _do(fn):
+        register_backend(backend, [fn])
+        return fn
+
+    return _do
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+def clone(sym):
+    """Deep-copy the reachable graph (variables keep identity semantics by
+    name; they are cloned too so passes can rewire them safely)."""
+    mapping = {}
+    for n in _topo(sym._heads):
+        c = _Node(n.op, n.name, dict(n.attrs),
+                  [(mapping[id(i)], idx) for i, idx in n.inputs],
+                  n.nout, n.value)
+        mapping[id(n)] = c
+    return Symbol([(mapping[id(n)], i) for n, i in sym._heads]), mapping
+
+
+def optimize_for(sym, backend, **kwargs):
+    """Apply a backend's passes; returns a new Symbol
+    (reference: Symbol.optimize_for)."""
+    if backend not in _BACKENDS:
+        raise MXNetError(
+            f"unknown subgraph backend {backend!r}; registered: "
+            f"{list_backends()}")
+    out, _ = clone(sym)
+    for p in _BACKENDS[backend]:
+        out = p(out, **kwargs) if _accepts_kwargs(p) else p(out)
+    return out
+
+
+def _accepts_kwargs(fn):
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return any(p.kind == inspect.Parameter.VAR_KEYWORD
+               for p in sig.parameters.values())
+
+
+def env_backend():
+    """MXNET_SUBGRAPH_BACKEND: backend applied automatically at bind time
+    (reference: executor attach-time partitioning)."""
+    return os.environ.get("MXNET_SUBGRAPH_BACKEND") or None
+
+
+def apply_env_backend(sym):
+    b = env_backend()
+    if b and b in _BACKENDS:
+        return optimize_for(sym, b)
+    return sym
+
+
+# --------------------------------------------------------------------------
+# generic chain-fusion helper for pass authors
+# --------------------------------------------------------------------------
+def fuse_linear_chain(sym, pattern, fused_op, make_attrs=None):
+    """Fuse every producer->consumer chain matching ``pattern`` into one
+    ``fused_op`` node.
+
+    pattern: list of predicates ``fn(node) -> bool`` (length >= 2); node i+1
+    must consume node i's output as its FIRST input, node i must have a
+    single consumer and one output.  The fused node takes the first node's
+    inputs plus every later node's non-chain inputs, in order.  attrs come
+    from ``make_attrs(nodes) -> dict`` (default: merged attrs).
+
+    Mutates ``sym`` in place — call on a :func:`clone` (optimize_for does).
+    """
+    nodes = _topo(sym._heads)
+    consumers = {}
+    for n in nodes:
+        for inp, _ in n.inputs:
+            consumers[id(inp)] = consumers.get(id(inp), 0) + 1
+    for n, _ in sym._heads:
+        consumers[id(n)] = consumers.get(id(n), 0) + 1000  # heads stay live
+
+    def chain_at(last):
+        chain = [last]
+        cur = last
+        for pred in reversed(pattern[:-1]):
+            if not cur.inputs:
+                return None
+            prev = cur.inputs[0][0]
+            if prev.op is None or not pred(prev) or prev.nout != 1 or \
+                    consumers.get(id(prev), 0) != 1:
+                return None
+            chain.insert(0, prev)
+            cur = prev
+        return chain
+
+    fused_count = 0
+    replaced = {}  # id(old tail) -> fused node
+    for n in nodes:
+        if n.op is None or not pattern[-1](n):
+            continue
+        chain = chain_at(n)
+        if chain is None:
+            continue
+        inputs = list(chain[0].inputs)
+        for later in chain[1:]:
+            inputs.extend(later.inputs[1:])
+        attrs = {}
+        if make_attrs is not None:
+            attrs = make_attrs(chain)
+        else:
+            for c in chain:
+                attrs.update(c.attrs)
+        fused = _Node(fused_op, f"{chain[0].name}_{fused_op.lstrip('_')}",
+                      attrs, inputs, 1, None)
+        replaced[id(chain[-1])] = fused
+        fused_count += 1
+    if not replaced:
+        return sym
+    # rewire every consumer + head referencing a replaced tail (fused nodes
+    # included: a fused chain may consume another chain's output)
+    for n in list(_topo(sym._heads)) + list(replaced.values()):
+        n.inputs = [(replaced.get(id(i), i), idx) for i, idx in n.inputs]
+    sym._heads = [(replaced.get(id(n), n), i) for n, i in sym._heads]
+    return sym
+
+
+# --------------------------------------------------------------------------
+# built-in backend: operator-level fusions useful on the eager Executor
+# --------------------------------------------------------------------------
+@register("_sg_fused_dense_act")
+def _sg_fused_dense_act(x, weight, *maybe_bias, num_hidden=None,
+                        no_bias=False, flatten=True, act_type="relu"):
+    """FullyConnected+Activation as one op (subgraph 'default' backend)."""
+    fc = OP_TABLE["FullyConnected"].fn
+    act = OP_TABLE["Activation"].fn
+    return act(fc(x, weight, *maybe_bias, num_hidden=num_hidden,
+                  no_bias=no_bias, flatten=flatten), act_type=act_type)
+
+
+@register("_sg_fused_conv_act")
+def _sg_fused_conv_act(x, weight, *maybe_bias, kernel=None, stride=None,
+                       dilate=None, pad=None, num_filter=None, num_group=1,
+                       no_bias=False, layout=None, cudnn_tune=None,
+                       cudnn_off=None, workspace=None, act_type="relu"):
+    """Convolution+Activation as one op (subgraph 'default' backend)."""
+    conv = OP_TABLE["Convolution"].fn
+    act = OP_TABLE["Activation"].fn
+    return act(conv(x, weight, *maybe_bias, kernel=kernel, stride=stride,
+                    dilate=dilate, pad=pad, num_filter=num_filter,
+                    num_group=num_group, no_bias=no_bias, layout=layout),
+               act_type=act_type)
+
+
+def _is_op(*names):
+    s = set(names)
+    return lambda n: n.op in s
+
+
+@register_pass("default")
+def fuse_dense_activation(sym):
+    return fuse_linear_chain(
+        sym, [_is_op("FullyConnected"), _is_op("Activation", "activation")],
+        "_sg_fused_dense_act")
+
+
+@register_pass("default")
+def fuse_conv_activation(sym):
+    return fuse_linear_chain(
+        sym, [_is_op("Convolution"), _is_op("Activation", "activation")],
+        "_sg_fused_conv_act")
+
+
+# shape inference for the fused nodes reuses the base op's param rules
+from .symbol import symbol as _symbol_mod  # noqa: E402
+
+_symbol_mod._OP_SHAPE_HINT_ALIASES["_sg_fused_dense_act"] = "FullyConnected"
+_symbol_mod._OP_SHAPE_HINT_ALIASES["_sg_fused_conv_act"] = "Convolution"
+_symbol_mod._OP_PARAM_VARS["_sg_fused_dense_act"] = \
+    _symbol_mod._OP_PARAM_VARS["FullyConnected"]
+_symbol_mod._OP_PARAM_VARS["_sg_fused_conv_act"] = \
+    _symbol_mod._OP_PARAM_VARS["Convolution"]
+
+# the reference ships MKLDNN as its always-available backend; ours is the
+# XLA-oriented 'default' — register the reference names as aliases so
+# scripts that say optimize_for('MKLDNN') keep working
+register_backend("MKLDNN", _BACKENDS["default"])
+register_backend("ONEDNN", _BACKENDS["default"])

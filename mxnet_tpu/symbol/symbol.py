@@ -50,11 +50,17 @@ _OP_PARAM_VARS = {
 }
 
 
+# fused/derived ops inheriting a base op's param-shape rules (extended by
+# mxnet_tpu.subgraph for its fused nodes)
+_OP_SHAPE_HINT_ALIASES = {}
+
+
 def _param_shape_hints(op, attrs, data_shape):
     """Backward shape inference for auto-created parameter variables
     (reference: each op's FInferShape fills unknown input shapes; jax
     eval_shape is forward-only so the common param-bearing ops get explicit
     hints here)."""
+    op = _OP_SHAPE_HINT_ALIASES.get(op, op)
     a = attrs
     if op == "FullyConnected":
         nh = int(a["num_hidden"])
@@ -250,6 +256,15 @@ class Symbol:
     def _set_attr(self, **kwargs):
         for node, _ in self._heads:
             node.attrs.update(kwargs)
+
+    def optimize_for(self, backend, args=None, aux=None, ctx=None, **kwargs):
+        """Apply a registered subgraph backend's partitioning passes
+        (reference: Symbol.optimize_for over src/operator/subgraph/).
+        args/aux/ctx are accepted for signature parity; passes here run
+        shape-oblivious."""
+        from .. import subgraph
+
+        return subgraph.optimize_for(self, backend, **kwargs)
 
     def get_internals(self):
         nodes = _topo(self._heads)

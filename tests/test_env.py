@@ -1,0 +1,70 @@
+"""MXNET_* env-var behavior layer (reference: docs env_var.md + dmlc::GetEnv
+reads — SURVEY.md §6.6)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code, extra_env):
+    e = dict(os.environ)
+    e["PYTHONPATH"] = REPO + os.pathsep + e.get("PYTHONPATH", "")
+    e["JAX_PLATFORMS"] = "cpu"
+    e.update(extra_env)
+    pre = ("import jax; jax.config.update('jax_platforms','cpu');\n")
+    return subprocess.run([sys.executable, "-c", pre + code], env=e,
+                          capture_output=True, text=True, timeout=240)
+
+
+def test_get_int_bad_value_warns_and_defaults():
+    os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = "not-a-number"
+    try:
+        import warnings
+
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            assert env.kvstore_bigarray_bound() == 1000000
+    finally:
+        del os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"]
+
+
+def test_cpu_worker_nthreads_env():
+    os.environ["MXNET_CPU_WORKER_NTHREADS"] = "7"
+    try:
+        assert env.cpu_worker_nthreads() == 7
+    finally:
+        del os.environ["MXNET_CPU_WORKER_NTHREADS"]
+    assert env.cpu_worker_nthreads() >= 1
+
+
+def test_describe_lists_wired_and_subsumed():
+    text = env.describe()
+    assert "MXNET_ENGINE_TYPE" in text
+    assert "MXNET_EXEC_BULK_EXEC_TRAIN" in text and "subsumed" in text
+
+
+def test_mxnet_seed_makes_runs_reproducible():
+    code = ("import mxnet_tpu as mx;"
+            "print(mx.nd.random.uniform(shape=(4,)).asnumpy().tolist())")
+    a = _run(code, {"MXNET_SEED": "1234"})
+    b = _run(code, {"MXNET_SEED": "1234"})
+    c = _run(code, {"MXNET_SEED": "99"})
+    assert a.returncode == 0, a.stderr
+    assert a.stdout == b.stdout
+    assert a.stdout != c.stdout
+
+
+def test_profiler_autostart():
+    code = ("import mxnet_tpu as mx;"
+            "from mxnet_tpu import profiler;"
+            "print('running' if profiler._CONFIG.get('profile_all')"
+            " else 'off')")
+    r = _run(code, {"MXNET_PROFILER_AUTOSTART": "1"})
+    assert r.returncode == 0, r.stderr
+    assert "running" in r.stdout

@@ -1,0 +1,109 @@
+"""Subgraph/partitioning API (reference: Symbol.optimize_for +
+src/operator/subgraph/, tests/python/unittest/test_subgraph_op.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import subgraph
+from mxnet_tpu.symbol.symbol import _topo
+
+
+def _mlp():
+    x = mx.sym.var("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(x, num_hidden=16, name="fc1"),
+                          act_type="relu", name="act1")
+    h = mx.sym.Activation(mx.sym.FullyConnected(h, num_hidden=8, name="fc2"),
+                          act_type="tanh", name="act2")
+    return mx.sym.FullyConnected(h, num_hidden=4, name="fc3")
+
+
+def _feed(sym, seed=0):
+    rs = np.random.RandomState(seed)
+    feed = {}
+    shapes = {"data": (3, 5)}
+    args = sym.list_arguments()
+    inferred, _, _ = sym.infer_shape(data=(3, 5))
+    for name, shp in zip(args, inferred):
+        feed[name] = mx.nd.array(rs.randn(*shp).astype("f"))
+    return feed
+
+
+def test_optimize_for_fuses_and_preserves_outputs():
+    sym = _mlp()
+    n_before = len(_topo(sym._heads))
+    fused = sym.optimize_for("default")
+    n_after = len(_topo(fused._heads))
+    assert n_after == n_before - 2  # two FC+Act pairs collapsed
+    feed = _feed(sym)
+    ex1 = sym.bind(mx.cpu(), dict(feed))
+    ex2 = fused.bind(mx.cpu(), dict(feed))
+    y1 = ex1.forward()[0].asnumpy()
+    y2 = ex2.forward()[0].asnumpy()
+    assert np.allclose(y1, y2, atol=1e-5)
+    # original symbol untouched
+    assert len(_topo(sym._heads)) == n_before
+
+
+def test_fused_graph_gradients_match():
+    sym = _mlp()
+    fused = sym.optimize_for("default")
+    feed = _feed(sym, seed=1)
+    g1 = {k: mx.nd.zeros(v.shape) for k, v in feed.items()}
+    g2 = {k: mx.nd.zeros(v.shape) for k, v in feed.items()}
+    ex1 = sym.bind(mx.cpu(), dict(feed), args_grad=g1)
+    ex2 = fused.bind(mx.cpu(), dict(feed), args_grad=g2)
+    og = mx.nd.ones((3, 4))
+    ex1.forward(is_train=True)
+    ex1.backward(og)
+    ex2.forward(is_train=True)
+    ex2.backward(og)
+    for k in g1:
+        assert np.allclose(g1[k].asnumpy(), g2[k].asnumpy(), atol=1e-4), k
+
+
+def test_unknown_backend_raises():
+    with pytest.raises(Exception):
+        _mlp().optimize_for("no_such_backend")
+
+
+def test_user_registered_backend_pass():
+    calls = []
+
+    @subgraph.register_pass("my_backend_test")
+    def strip_nothing(sym):
+        calls.append(1)
+        return sym
+
+    out = _mlp().optimize_for("my_backend_test")
+    assert calls == [1]
+    assert out.list_arguments() == _mlp().list_arguments()
+
+
+def test_env_backend_applied_at_module_bind():
+    os.environ["MXNET_SUBGRAPH_BACKEND"] = "default"
+    try:
+        sym = mx.sym.LinearRegressionOutput(
+            mx.sym.Activation(
+                mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2,
+                                      name="fc"),
+                act_type="relu"),
+            mx.sym.var("softmax_label"))
+        mod = mx.mod.Module(sym, data_names=["data"],
+                            label_names=["softmax_label"])
+        mod.bind(data_shapes=[("data", (4, 3))],
+                 label_shapes=[("softmax_label", (4, 2))])
+        ops = {n.op for n in _topo(mod._bind_symbol._heads)}
+        assert "_sg_fused_dense_act" in ops
+        # the user-visible symbol stays unfused (checkpoints round-trip)
+        user_ops = {n.op for n in _topo(mod._symbol._heads)}
+        assert "_sg_fused_dense_act" not in user_ops
+    finally:
+        del os.environ["MXNET_SUBGRAPH_BACKEND"]
+
+
+def test_mkldnn_alias_backend():
+    fused = _mlp().optimize_for("MKLDNN")
+    ops = {n.op for n in _topo(fused._heads)}
+    assert "_sg_fused_dense_act" in ops
