@@ -1,0 +1,154 @@
+"""SVRG (stochastic variance-reduced gradient) optimization.
+
+Reference: ``python/mxnet/contrib/svrg_optimization/{svrg_module,
+svrg_optimizer}.py`` (SURVEY.md §3.5 contrib misc): SVRGModule keeps a
+snapshot of the weights, the full-dataset gradient μ at that snapshot, and
+adjusts every minibatch gradient to ``g_i(w) - g_i(w_snap) + μ`` — variance
+reduction that restores linear convergence for strongly-convex objectives
+(Johnson & Zhang 2013).
+
+TPU-native shape: the reference routes the correction through a special
+``_SVRGOptimizer`` registered into the kvstore so parameter-server updates
+stay oblivious; here the correction happens at the module level (the
+snapshot module's backward runs in the same XLA program family as the main
+one, so both gradient evaluations stay on-device) and the base optimizer's
+updater is applied to the corrected gradient directly.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from ..module.module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """Module with SVRG gradient correction.
+
+    Parameters mirror Module plus ``update_freq``: the number of epochs
+    between full-gradient snapshots (the reference's semantics).
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context, **kwargs)
+        if int(update_freq) < 1:
+            raise MXNetError("update_freq must be >= 1")
+        self.update_freq = int(update_freq)
+        # snapshot module: same graph, frozen weights w_snap
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               context=context, **kwargs)
+        self._full_grads = None   # μ per param name
+
+    # -- lifecycle mirrors Module, driving both executors ------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                           inputs_need_grad, force_rebind, None, grad_req)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        super().init_params(initializer, arg_params, aux_params,
+                            allow_missing, force_init, allow_extra)
+        self._sync_snapshot()
+
+    def _sync_snapshot(self):
+        arg, aux = self.get_params()
+        self._mod_aux.set_params(arg, aux)
+
+    def update_full_grads(self, train_data):
+        """Snapshot the current weights and accumulate μ = the mean
+        gradient of the FULL dataset at those weights (reference:
+        SVRGModule.update_full_grads)."""
+        import numpy as np
+
+        self._sync_snapshot()
+        sums = {}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            nbatch += 1
+            for name in self._param_names:
+                g = self._mod_aux._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                ga = g.asnumpy()
+                sums[name] = ga if name not in sums else sums[name] + ga
+        if nbatch == 0:
+            raise MXNetError("update_full_grads: empty train_data")
+        self._full_grads = {k: v / nbatch for k, v in sums.items()}
+        train_data.reset()
+
+    def forward_backward(self, data_batch):
+        """Main forward/backward plus the snapshot-weight backward on the
+        same batch (the two gradient evaluations SVRG needs)."""
+        self.forward(data_batch, is_train=True)
+        self.backward()
+        if self._full_grads is not None:
+            self._mod_aux.forward(data_batch, is_train=True)
+            self._mod_aux.backward()
+
+    def update(self):
+        """Apply the base optimizer to the corrected gradient
+        g(w) - g(w_snap) + μ (falls back to plain SGD-style update before
+        the first snapshot)."""
+        if not self.optimizer_initialized:
+            raise MXNetError("call init_optimizer before update")
+        from .. import ndarray as nd
+
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            if self._full_grads is not None and name in self._full_grads:
+                g_snap = self._mod_aux._exec.grad_dict[name]
+                grad = grad - g_snap + nd.array(self._full_grads[name])
+            self._updater(i, grad, self._exec.arg_dict[name])
+
+    def fit(self, train_data, eval_metric="mse", epoch_end_callback=None,
+            batch_end_callback=None, kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, num_epoch=1, **kwargs):
+        """SVRG training schedule: refresh μ every ``update_freq`` epochs
+        (reference: SVRGModule.fit)."""
+        from .. import metric as _metric
+        from .. import initializer as _init
+
+        if not self.binded:
+            train_data.reset()
+            first = next(iter(train_data))
+            self.bind(data_shapes=[("data", tuple(first.data[0].shape))],
+                      label_shapes=[("softmax_label",
+                                     tuple(first.label[0].shape))])
+            train_data.reset()
+        if not self.params_initialized:
+            self.init_params(initializer or _init.Uniform(0.01))
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if isinstance(eval_metric, str):
+            eval_metric = _metric.create(eval_metric)
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            train_data.reset()
+            for batch in train_data:
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback:
+                    batch_end_callback(epoch)
+            if epoch_end_callback:
+                epoch_end_callback(epoch)
+        return eval_metric

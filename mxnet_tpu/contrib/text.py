@@ -1,0 +1,211 @@
+"""Text utilities: token counting, vocabulary, token embeddings.
+
+Reference: ``python/mxnet/contrib/text/{utils,vocab,embedding}.py``
+(SURVEY.md §3.5 contrib misc).  Pretrained-embedding *downloads* are
+unavailable offline — ``CustomEmbedding`` loads any local
+``token<space>v1 v2 …`` file, which is the same code path the reference's
+GloVe/fastText classes use after their download step.
+"""
+from __future__ import annotations
+
+import collections
+import re
+
+from ..base import MXNetError
+
+__all__ = ["count_tokens_from_str", "Vocabulary", "TokenEmbedding",
+           "CustomEmbedding"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens (reference: contrib.text.utils.count_tokens_from_str)."""
+    source_str = re.sub(f"({token_delim})|({seq_delim})", " ", source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(source_str.split())
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary (reference: contrib.text.vocab.Vocabulary).
+
+    Index 0 is the unknown token; reserved tokens follow, then counted
+    tokens by descending frequency (ties broken alphabetically, matching
+    the reference sort).
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            if len(set(reserved_tokens)) != len(reserved_tokens) or \
+                    unknown_token in reserved_tokens:
+                raise MXNetError("reserved_tokens must be unique and must "
+                                 "not contain unknown_token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens or [])
+        self._idx_to_token = [unknown_token] + self._reserved_tokens
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            skip = set(self._idx_to_token)
+            for tok, freq in pairs:
+                if freq >= min_freq and tok not in skip:
+                    self._idx_to_token.append(tok)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idx = [indices] if single else indices
+        out = []
+        for i in idx:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError(f"token index {i} out of range")
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out
+
+
+class TokenEmbedding(Vocabulary):
+    """Vocabulary + vector per token (reference:
+    contrib.text.embedding._TokenEmbedding).  The unknown token maps to
+    ``init_unknown_vec`` (zeros by default)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def _load_embedding_file(self, path, elem_delim=" ", encoding="utf8",
+                             init_unknown_vec=None):
+        import numpy as np
+
+        from .. import ndarray as nd
+
+        rows = []
+        with open(path, encoding=encoding) as f:
+            for line in f:
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                try:
+                    rows.append((parts[0],
+                                 np.asarray([float(v) for v in parts[1:]],
+                                            "f")))
+                except ValueError:
+                    continue
+        if not rows:
+            raise MXNetError(f"no vectors found in {path}")
+        # the embedding dim is the majority row length — robust to a
+        # "count dim" header line (its length differs from the data rows)
+        # including the 1-D-embedding case the old >1-values guard broke
+        import collections as _collections
+
+        vec_len = _collections.Counter(
+            len(v) for _, v in rows).most_common(1)[0][0]
+        vecs = {tok: v for tok, v in rows if len(v) == vec_len}
+        if not vecs:
+            raise MXNetError(f"no vectors found in {path}")
+        self._vec_len = vec_len
+        # extend the index with every token in the file
+        for tok in vecs:
+            if tok not in self._token_to_idx:
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+        mat = np.zeros((len(self), vec_len), "f")
+        for tok, v in vecs.items():
+            mat[self._token_to_idx[tok]] = v
+        unk = (init_unknown_vec or (lambda shape: np.zeros(shape, "f")))
+        mat[0] = np.asarray(unk((vec_len,)), "f").reshape(vec_len)
+        self._idx_to_vec = nd.array(mat)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        from .. import ndarray as nd
+
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = []
+        for t in toks:
+            i = self._token_to_idx.get(t, 0)
+            if i == 0 and lower_case_backup:
+                i = self._token_to_idx.get(t.lower(), 0)
+            idx.append(i)
+        rows = nd.take(self._idx_to_vec, nd.array(idx, dtype="int32"), axis=0)
+        return rows[0] if single else rows
+
+    def update_token_vectors(self, tokens, new_vectors):
+        from .. import ndarray as nd
+        import numpy as np
+
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        mat = self._idx_to_vec.asnumpy().copy()
+        vals = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else np.asarray(new_vectors, "f")
+        vals = vals.reshape((len(toks), self._vec_len))
+        for t, v in zip(toks, vals):
+            if t not in self._token_to_idx:
+                raise MXNetError(f"token {t!r} is unknown; only existing "
+                                 "tokens can be updated")
+            mat[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(mat)
+
+    def __getitem__(self, tokens):
+        return self.get_vecs_by_tokens(tokens)
+
+
+class CustomEmbedding(TokenEmbedding):
+    """Embedding loaded from a local ``token v1 v2 …`` text file
+    (reference: contrib.text.embedding.CustomEmbedding; the GloVe/fastText
+    subclasses differ only in their download step, which offline builds
+    replace with a local file path)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 init_unknown_vec=None, vocabulary=None, **kwargs):
+        if vocabulary is not None:
+            self._unknown_token = vocabulary.unknown_token
+            self._reserved_tokens = list(vocabulary.reserved_tokens)
+            self._idx_to_token = list(vocabulary.idx_to_token)
+            self._token_to_idx = dict(vocabulary.token_to_idx)
+            self._vec_len = 0
+            self._idx_to_vec = None
+        else:
+            super().__init__(**kwargs)
+        self._load_embedding_file(pretrained_file_path, elem_delim, encoding,
+                                  init_unknown_vec)

@@ -1,0 +1,121 @@
+"""contrib.text + contrib.svrg_optimization (reference:
+tests/python/unittest/test_contrib_text.py, tests/python/unittest/
+test_contrib_svrg_module.py)."""
+import collections
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+
+def test_count_tokens_from_str():
+    c = text.count_tokens_from_str("a b  b\nc a a", to_lower=False)
+    assert c == collections.Counter({"a": 3, "b": 2, "c": 1})
+    c2 = text.count_tokens_from_str("A a", to_lower=True)
+    assert c2["a"] == 2
+
+
+def test_vocabulary_ordering_and_lookup():
+    counter = collections.Counter({"the": 5, "cat": 3, "dog": 3, "rare": 1})
+    v = text.Vocabulary(counter, min_freq=2, reserved_tokens=["<pad>"])
+    assert v.idx_to_token[:2] == ["<unk>", "<pad>"]
+    # frequency order, alphabetical ties
+    assert v.idx_to_token[2:] == ["the", "cat", "dog"]
+    assert v.to_indices("the") == 2
+    assert v.to_indices(["cat", "nope"]) == [3, 0]
+    assert v.to_tokens([0, 4]) == ["<unk>", "dog"]
+    assert len(v) == 5
+
+
+def test_vocabulary_rejects_bad_reserved():
+    with pytest.raises(Exception):
+        text.Vocabulary(reserved_tokens=["<unk>"])
+    with pytest.raises(Exception):
+        text.Vocabulary(reserved_tokens=["a", "a"])
+
+
+def test_custom_embedding_loads_file(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    assert np.allclose(emb["hello"].asnumpy(), [1, 2, 3])
+    vecs = emb.get_vecs_by_tokens(["world", "missing"])
+    assert np.allclose(vecs.asnumpy()[0], [4, 5, 6])
+    assert np.allclose(vecs.asnumpy()[1], 0)  # unk -> zeros
+    emb.update_token_vectors("hello", mx.nd.array([[9.0, 9.0, 9.0]]))
+    assert np.allclose(emb["hello"].asnumpy(), 9)
+
+
+def test_custom_embedding_with_vocabulary(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("2 3\nalpha 1.0 0.0\nbeta 0.0 1.0\n")  # header line skipped
+    v = text.Vocabulary(collections.Counter({"alpha": 2, "gamma": 1}))
+    emb = text.CustomEmbedding(str(p), vocabulary=v)
+    assert np.allclose(emb["alpha"].asnumpy(), [1, 0])
+    assert np.allclose(emb["gamma"].asnumpy(), 0)  # in vocab, no vector
+
+
+def _toy_regression_iter(n=64, batch=16, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 4).astype("f")
+    w = np.array([1.0, -2.0, 0.5, 3.0], "f")
+    Y = (X @ w).reshape(-1, 1).astype("f")
+    return mx.io.NDArrayIter(X, Y, batch_size=batch, label_name="lro_label")
+
+
+def _linreg_symbol():
+    data = mx.sym.var("data")
+    label = mx.sym.var("lro_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    return mx.sym.LinearRegressionOutput(fc, label, name="lro")
+
+
+def test_svrg_module_converges():
+    """SVRG fit drives MSE down on a linear problem (reference:
+    test_contrib_svrg_module.py convergence check)."""
+    it = _toy_regression_iter()
+    mod = SVRGModule(_linreg_symbol(), data_names=("data",),
+                     label_names=("lro_label",), update_freq=2)
+    metric = mod.fit(it, eval_metric="mse", optimizer="sgd",
+                     optimizer_params=(("learning_rate", 0.2),),
+                     num_epoch=10)
+    name, val = metric.get()
+    assert val < 0.05, (name, val)
+
+
+def test_svrg_full_grads_is_dataset_mean():
+    """μ equals the mean of per-batch gradients at the snapshot weights."""
+    it = _toy_regression_iter()
+    mod = SVRGModule(_linreg_symbol(), data_names=("data",),
+                     label_names=("lro_label",), update_freq=1)
+    mod.bind(data_shapes=[("data", (16, 4))],
+             label_shapes=[("lro_label", (16, 1))])
+    mod.init_params(mx.init.Uniform(0.5))
+    mod.update_full_grads(it)
+    # manual mean over batches with the snapshot module
+    sums, nb = {}, 0
+    it.reset()
+    for batch in it:
+        mod._mod_aux.forward(batch, is_train=True)
+        mod._mod_aux.backward()
+        nb += 1
+        for n in mod._param_names:
+            g = mod._mod_aux._exec.grad_dict[n].asnumpy()
+            sums[n] = g if n not in sums else sums[n] + g
+    for n in mod._param_names:
+        assert np.allclose(mod._full_grads[n], sums[n] / nb, atol=1e-5)
+
+
+def test_custom_embedding_one_dimensional(tmp_path):
+    """dim-1 embedding files load (review finding: the header guard
+    rejected every 1-value row)."""
+    p = tmp_path / "d1.txt"
+    p.write_text("hot 1.0\ncold -1.0\n")
+    emb = text.CustomEmbedding(str(p))
+    assert emb.vec_len == 1
+    assert np.allclose(emb["hot"].asnumpy(), [1.0])
+    assert np.allclose(emb["cold"].asnumpy(), [-1.0])
