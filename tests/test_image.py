@@ -1,0 +1,191 @@
+"""mx.image augmenters + ImageDetIter (reference:
+tests/python/unittest/test_image.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img
+from mxnet_tpu.recordio import MXIndexedRecordIO, IRHeader, pack_img
+
+
+def _chw_img(h=32, w=40, seed=0):
+    return np.random.RandomState(seed).uniform(
+        0, 255, (h, w, 3)).astype("float32")
+
+
+def test_horizontal_flip_aug():
+    np.random.seed(0)
+    aug = img.HorizontalFlipAug(p=1.0)
+    s = _chw_img()
+    out = aug(mx.nd.array(s)).asnumpy()
+    assert np.allclose(out, s[:, ::-1])
+
+
+def test_brightness_and_normalize_augs():
+    np.random.seed(1)
+    s = _chw_img()
+    b = img.BrightnessJitterAug(0.0)(mx.nd.array(s)).asnumpy()
+    assert np.allclose(b, s)  # zero jitter = identity
+    mean = np.array([1.0, 2.0, 3.0], "f")
+    std = np.array([2.0, 2.0, 2.0], "f")
+    n = img.ColorNormalizeAug(mean, std)(mx.nd.array(s)).asnumpy()
+    assert np.allclose(n, (s - mean) / std, atol=1e-5)
+
+
+def test_saturation_zero_is_identity_and_gray_converges():
+    s = _chw_img(seed=3)
+    out = img.SaturationJitterAug(0.0)(mx.nd.array(s)).asnumpy()
+    assert np.allclose(out, s, atol=1e-4)
+    g = img.RandomGrayAug(p=1.0)(mx.nd.array(s)).asnumpy()
+    assert np.allclose(g[..., 0], g[..., 1]) and \
+        np.allclose(g[..., 1], g[..., 2])
+
+
+def test_create_augmenter_pipeline_shapes():
+    np.random.seed(2)
+    augs = img.CreateAugmenter((3, 24, 24), rand_crop=True, rand_mirror=True,
+                               mean=True, std=True, brightness=0.1,
+                               contrast=0.1, saturation=0.1)
+    s = mx.nd.array(_chw_img(48, 64))
+    for a in augs:
+        s = a(s)
+    assert s.shape == (24, 24, 3)
+    assert s.asnumpy().dtype == np.float32
+
+
+def test_random_size_crop_respects_bounds():
+    np.random.seed(4)
+    out, (x0, y0, w, h) = img.random_size_crop(
+        mx.nd.array(_chw_img(40, 40)), (16, 16), (0.1, 0.5), (0.8, 1.25))
+    assert out.shape == (16, 16, 3)
+    assert 0 <= x0 and x0 + w <= 40 and 0 <= y0 and y0 + h <= 40
+
+
+def test_det_flip_updates_boxes():
+    np.random.seed(0)
+    label = np.array([[1, 0.1, 0.2, 0.4, 0.6],
+                      [-1, -1, -1, -1, -1]], "f")
+    aug = img.DetHorizontalFlipAug(p=1.0)
+    s, lab = aug(mx.nd.array(_chw_img()), label)
+    assert np.allclose(lab[0], [1, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+    assert (lab[1] == -1).all()
+
+
+def test_det_random_crop_keeps_coverage():
+    np.random.seed(5)
+    label = np.array([[0, 0.3, 0.3, 0.7, 0.7]], "f")
+    aug = img.DetRandomCropAug(min_object_covered=0.5, max_attempts=100)
+    s, lab = aug(mx.nd.array(_chw_img(64, 64)), label)
+    valid = lab[lab[:, 0] >= 0]
+    assert len(valid) >= 1
+    b = valid[0]
+    assert 0 <= b[1] <= b[3] <= 1 and 0 <= b[2] <= b[4] <= 1
+
+
+def test_det_random_pad_shrinks_boxes():
+    np.random.seed(6)
+    label = np.array([[2, 0.0, 0.0, 1.0, 1.0]], "f")
+    aug = img.DetRandomPadAug(area_range=(2.0, 3.0), max_attempts=100)
+    s, lab = aug(mx.nd.array(_chw_img(32, 32)), label)
+    b = lab[0]
+    area = (b[3] - b[1]) * (b[4] - b[2])
+    assert area < 1.0  # padded out -> box occupies less of the canvas
+    assert s.shape[0] >= 32 and s.shape[1] >= 32
+
+
+def _write_det_rec(tmp_path, n=6):
+    rec_p = str(tmp_path / "det.rec")
+    idx_p = str(tmp_path / "det.idx")
+    w = MXIndexedRecordIO(idx_p, rec_p, "w")
+    rs = np.random.RandomState(0)
+    for i in range(n):
+        im_arr = rs.uniform(0, 255, (48, 48, 3)).astype("uint8")
+        # reference det header: [header_width=2, object_width=5, objs...]
+        label = np.concatenate([[2, 5],
+                                [i % 3, 0.2, 0.2, 0.8, 0.8],
+                                [1, 0.1, 0.5, 0.4, 0.9]]).astype("f")
+        w.write_idx(i, pack_img(IRHeader(0, label, i, 0), im_arr,
+                                img_fmt=".npy"))
+    w.close()
+    return rec_p
+
+
+def test_image_det_iter(tmp_path):
+    np.random.seed(7)
+    rec = _write_det_rec(tmp_path)
+    it = img.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                          path_imgrec=rec,
+                          aug_list=img.CreateDetAugmenter(
+                              (3, 32, 32), rand_mirror=True))
+    batches = list(it)
+    assert len(batches) == 3
+    d, lab = batches[0].data[0], batches[0].label[0]
+    assert d.shape == (2, 3, 32, 32)
+    assert lab.shape[0] == 2 and lab.shape[2] == 5
+    la = lab.asnumpy()
+    valid = la[la[..., 0] >= 0]
+    assert len(valid) > 0
+    assert (valid[:, 1:] >= -1e-6).all() and (valid[:, 1:] <= 1 + 1e-6).all()
+
+
+def test_image_iter_still_works(tmp_path):
+    rec_p = str(tmp_path / "cls.rec")
+    idx_p = str(tmp_path / "cls.idx")
+    w = MXIndexedRecordIO(idx_p, rec_p, "w")
+    rs = np.random.RandomState(1)
+    for i in range(4):
+        w.write_idx(i, pack_img(IRHeader(0, float(i), i, 0),
+                                rs.uniform(0, 255, (20, 20, 3)).astype(
+                                    "uint8"), img_fmt=".npy"))
+    w.close()
+    it = img.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                       path_imgrec=rec_p)
+    b = next(iter(it))
+    assert b.data[0].shape == (2, 3, 16, 16)
+    assert b.label[0].shape == (2,)
+
+
+def test_image_iter_applies_aug_list(tmp_path):
+    """aug_list must actually run (review finding: it was stored-and-ignored)."""
+    rec_p = str(tmp_path / "aug.rec")
+    idx_p = str(tmp_path / "aug.idx")
+    w = MXIndexedRecordIO(idx_p, rec_p, "w")
+    const = np.full((20, 20, 3), 100.0, "f").astype("uint8")
+    for i in range(2):
+        w.write_idx(i, pack_img(IRHeader(0, float(i), i, 0), const,
+                                img_fmt=".npy"))
+    w.close()
+    mean = np.array([100.0, 100.0, 100.0], "f")
+    it = img.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                       path_imgrec=rec_p,
+                       aug_list=[img.CastAug(),
+                                 img.ForceResizeAug((16, 16)),
+                                 img.ColorNormalizeAug(mean, None)])
+    b = next(iter(it))
+    assert np.allclose(b.data[0].asnumpy(), 0.0, atol=1e-2)
+
+
+def test_image_det_iter_plain_labels(tmp_path):
+    """Headerless [cls x1 y1 x2 y2] labels parse, including cls_id >= 2
+    (review finding: the header heuristic divided by int(0.1) == 0)."""
+    rec_p = str(tmp_path / "plain.rec")
+    idx_p = str(tmp_path / "plain.idx")
+    w = MXIndexedRecordIO(idx_p, rec_p, "w")
+    rs = np.random.RandomState(0)
+    labels = [np.array([2.0, 0.1, 0.2, 0.8, 0.9], "f"),
+              np.array([0.0, 0.2, 0.2, 0.5, 0.5,
+                        1.0, 0.1, 0.1, 0.3, 0.3], "f")]
+    for i, lab in enumerate(labels):
+        w.write_idx(i, pack_img(IRHeader(0, lab, i, 0),
+                                rs.uniform(0, 255, (24, 24, 3)).astype(
+                                    "uint8"), img_fmt=".npy"))
+    w.close()
+    it = img.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                          path_imgrec=rec_p,
+                          aug_list=[])  # no augs: raw geometry
+    assert it._max_objs == 2
+    b = next(iter(it))
+    la = b.label[0].asnumpy()
+    assert la.shape == (2, 2, 5)
+    assert np.allclose(la[0, 0], [2.0, 0.1, 0.2, 0.8, 0.9], atol=1e-5)
+    assert (la[0, 1] == -1).all()
