@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# CI lane runner (reference: ci/docker/runtime_functions.sh — SURVEY.md §3.7).
+#
+# Usage: ci/runtest.sh <lane>
+# Lanes:
+#   unit        CPU unit suite on the 8-virtual-device mesh (default)
+#   tpu         real-chip consistency lane (MXNET_TEST_TPU=1)
+#   dist        2-process launcher tests only
+#   sanity      import + flake-level checks, no heavy tests
+#   bench       headline benchmarks (runs on whatever backend is live)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+LANE="${1:-unit}"
+
+# non-hardware lanes run on the CPU mesh; the axon sitecustomize
+# force-selects the TPU platform, so pin it back via jax config too
+CPU_PIN="import jax; jax.config.update('jax_platforms','cpu');"
+
+case "$LANE" in
+  sanity)
+    JAX_PLATFORMS=cpu python -c "$CPU_PIN import mxnet_tpu as mx; print(mx.runtime.feature_list())"
+    python -m compileall -q mxnet_tpu
+    ;;
+  unit)
+    JAX_PLATFORMS=cpu python -m pytest tests/ -x -q
+    ;;
+  tpu)
+    MXNET_TEST_TPU=1 python -m pytest tests/test_tpu_consistency.py -q
+    ;;
+  dist)
+    JAX_PLATFORMS=cpu python -m pytest tests/test_distributed.py -q
+    ;;
+  bench)
+    python bench.py
+    ;;
+  *)
+    echo "unknown lane: $LANE (unit|tpu|dist|sanity|bench)" >&2
+    exit 2
+    ;;
+esac

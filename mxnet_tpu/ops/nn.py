@@ -111,22 +111,39 @@ def deconvolution(x, weight, *maybe_bias, kernel=None, stride=None, dilate=None,
                   pad=None, adj=None, num_filter=None, num_group=1,
                   no_bias=True, layout=None, target_shape=None, workspace=None,
                   cudnn_tune=None, cudnn_off=None):
+    """Transposed convolution (reference: src/operator/nn/deconvolution.cc —
+    the gradient of Convolution wrt its input, weight layout (in, out/g, *k)).
+
+    Lowered directly as a conv_general_dilated with lhs_dilation=strides on
+    the spatially-flipped kernel — the exact gradient program, so XLA:TPU
+    schedules it like any other conv (one MXU contraction, no scatter)."""
     lax = _lax()
     jnp = _jnp()
     nd = x.ndim - 2
     strides = _tup(stride, nd)
     pads = _tup(pad, nd) if pad is not None else (0,) * nd
     dil = _tup(dilate, nd)
-    # weight layout (in_ch, out_ch/g, *k) like the reference; conv_transpose
-    # wants IOHW-style via dimension numbers
-    dn = lax.conv_dimension_numbers(
-        x.shape, weight.shape,
-        ("NCHW", "IOHW", "NCHW") if x.ndim == 4 else ("NCH", "IOH", "NCH"))
-    padding = [(d * (k - 1) - p, d * (k - 1) - p)
-               for k, p, d in zip(weight.shape[2:], pads, dil)]
-    y = lax.conv_transpose(x, weight, strides=strides, padding=padding,
-                           rhs_dilation=dil, dimension_numbers=dn,
-                           transpose_kernel=True)
+    adjs = _tup(adj, nd) if adj is not None else (0,) * nd
+    dn_str = {3: ("NCH", "IOH", "NCH"), 4: ("NCHW", "IOHW", "NCHW"),
+              5: ("NCDHW", "IODHW", "NCDHW")}[x.ndim]
+    w_flip = jnp.flip(weight, axis=tuple(range(2, weight.ndim)))
+    padding = [(d * (k - 1) - p, d * (k - 1) - p + a)
+               for k, p, d, a in zip(weight.shape[2:], pads, dil, adjs)]
+
+    def one_group(xg, wg):
+        dn = lax.conv_dimension_numbers(xg.shape, wg.shape, dn_str)
+        return lax.conv_general_dilated(
+            xg, wg, window_strides=(1,) * nd, padding=padding,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+
+    if num_group == 1:
+        y = one_group(x, w_flip)
+    else:
+        cin = x.shape[1] // num_group
+        ys = [one_group(x[:, g * cin:(g + 1) * cin],
+                        w_flip[g * cin:(g + 1) * cin])
+              for g in range(num_group)]
+        y = jnp.concatenate(ys, axis=1)
     if not no_bias and maybe_bias:
         y = y + maybe_bias[0].reshape((1, -1) + (1,) * nd)
     return y

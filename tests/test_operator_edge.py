@@ -1,0 +1,284 @@
+"""Operator edge-case coverage: dtype ladders, odd shapes, grad_req='add',
+views under autograd, Pooling/Deconv/BN configs (reference model: the
+breadth of tests/python/unittest/test_operator.py — SURVEY.md §5, VERDICT
+r3 weak #6)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.util.test_utils import assert_almost_equal
+
+# float64/int64 are deliberately absent: the TPU build runs with jax x64
+# disabled (TPU has no fp64 ALU; the reference's fp64 rows are a CPU-only
+# concern) — 64-bit inputs load as their 32-bit storage type
+_FLOATS = ["float16", "bfloat16", "float32"]
+_INTS = ["int8", "uint8", "int32"]
+_TOL = {"float16": 1e-2, "bfloat16": 2e-2, "float32": 1e-5, "float64": 1e-9}
+
+
+def _np_dt(dt):
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16) if dt == "bfloat16" else np.dtype(dt)
+
+
+# --------------------------------------------------------------------------
+# dtype ladders
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("dt", _FLOATS)
+def test_float_dtype_ladder_arithmetic(dt):
+    rs = np.random.RandomState(0)
+    a = rs.uniform(0.5, 2.0, (3, 4)).astype("float32")
+    b = rs.uniform(0.5, 2.0, (3, 4)).astype("float32")
+    x, y = nd.array(a, dtype=dt), nd.array(b, dtype=dt)
+    for op, ref in [(nd.elemwise_add, a + b), (nd.elemwise_mul, a * b),
+                    (nd.elemwise_div, a / b)]:
+        out = op(x, y)
+        assert out.dtype == _np_dt(dt), (op, out.dtype)
+        assert_almost_equal(out.asnumpy().astype("float32"), ref,
+                            rtol=_TOL[dt], atol=_TOL[dt])
+
+
+@pytest.mark.parametrize("dt", _FLOATS)
+def test_float_dtype_ladder_matmul_and_reduce(dt):
+    rs = np.random.RandomState(1)
+    a = rs.uniform(-1, 1, (4, 5)).astype("float32")
+    b = rs.uniform(-1, 1, (5, 3)).astype("float32")
+    out = nd.dot(nd.array(a, dtype=dt), nd.array(b, dtype=dt))
+    assert out.dtype == _np_dt(dt)
+    assert_almost_equal(out.asnumpy().astype("float32"), a @ b,
+                        rtol=max(_TOL[dt], 1e-4), atol=max(_TOL[dt], 1e-4))
+    s = nd.array(a, dtype=dt).sum(axis=0)
+    assert_almost_equal(s.asnumpy().astype("float32"), a.sum(0),
+                        rtol=_TOL[dt], atol=_TOL[dt] * 4)
+
+
+@pytest.mark.parametrize("dt", _INTS)
+def test_int_dtype_ladder(dt):
+    a = np.arange(12, dtype="int64").reshape(3, 4) % 7
+    x = nd.array(a, dtype=dt)
+    assert x.dtype == np.dtype(dt)
+    y = x + x
+    assert y.dtype == np.dtype(dt)
+    assert (y.asnumpy().astype("int64") == a + a).all()
+    s = x.sum()
+    assert int(s.asscalar()) == int(a.sum())
+
+
+def test_dtype_promotion_cast_chain():
+    x = nd.array(np.arange(6).reshape(2, 3), dtype="int32")
+    f = nd.Cast(x, dtype="float16")
+    assert f.dtype == np.dtype("float16")
+    d = nd.Cast(f, dtype="bfloat16")
+    import ml_dtypes
+    assert d.dtype == np.dtype(ml_dtypes.bfloat16)
+    assert (d.asnumpy().astype("f") == np.arange(6).reshape(2, 3)).all()
+
+
+# --------------------------------------------------------------------------
+# odd shapes
+# --------------------------------------------------------------------------
+def test_zero_size_arrays():
+    z = nd.zeros((0, 3))
+    assert z.shape == (0, 3)
+    assert (z + 1).shape == (0, 3)
+    assert z.sum().asscalar() == 0
+    c = nd.concat(z, nd.ones((2, 3)), dim=0)
+    assert c.shape == (2, 3)
+
+
+def test_scalar_and_rank1_shapes():
+    s = nd.array(3.5)
+    assert s.shape == ()
+    assert float((s * 2).asscalar()) == 7.0
+    v = nd.ones((1,))
+    assert (v + s).shape == (1,)
+
+
+def test_prime_and_highrank_shapes():
+    rs = np.random.RandomState(2)
+    a = rs.randn(7, 13).astype("f")
+    assert_almost_equal(nd.array(a).sum(axis=0), a.sum(0), rtol=1e-4)
+    b = rs.randn(2, 3, 4, 5, 6).astype("f")
+    out = nd.array(b).mean(axis=(1, 3))
+    assert_almost_equal(out, b.mean(axis=(1, 3)), rtol=1e-4)
+    t = nd.transpose(nd.array(b), (4, 2, 0, 3, 1))
+    assert t.shape == (6, 4, 2, 5, 3)
+    assert_almost_equal(t, b.transpose(4, 2, 0, 3, 1))
+
+
+def test_broadcast_with_size_one_dims():
+    a = np.random.RandomState(3).randn(1, 5, 1).astype("f")
+    b = np.random.RandomState(4).randn(4, 1, 2).astype("f")
+    assert_almost_equal(nd.broadcast_add(nd.array(a), nd.array(b)), a + b)
+
+
+def test_conv_odd_spatial_and_stride():
+    rs = np.random.RandomState(5)
+    x = rs.randn(1, 3, 11, 7).astype("f")
+    w = rs.randn(5, 3, 3, 3).astype("f")
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         stride=(2, 3), pad=(1, 0), num_filter=5,
+                         no_bias=True)
+    assert out.shape == (1, 5, 6, 2)
+
+
+# --------------------------------------------------------------------------
+# grad_req='add' and views under autograd
+# --------------------------------------------------------------------------
+def test_grad_req_add_accumulates():
+    x = nd.array(np.ones((2, 3), "f"))
+    x.attach_grad(grad_req="add")
+    for i in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), 6.0)  # 3 passes x grad 2
+    # write semantics reset every backward
+    w = nd.array(np.ones((2,), "f"))
+    w.attach_grad(grad_req="write")
+    for _ in range(3):
+        with autograd.record():
+            (w * 5).sum().backward()
+    assert np.allclose(w.grad.asnumpy(), 5.0)
+
+
+def test_gradient_through_slice_view():
+    x = nd.array(np.arange(12, dtype="f").reshape(3, 4))
+    x.attach_grad()
+    with autograd.record():
+        v = x[1:3, :2]
+        loss = (v * v).sum()
+    loss.backward()
+    expect = np.zeros((3, 4), "f")
+    expect[1:3, :2] = 2 * np.arange(12, dtype="f").reshape(3, 4)[1:3, :2]
+    assert np.allclose(x.grad.asnumpy(), expect)
+
+
+def test_view_write_through_then_compute():
+    x = nd.zeros((4, 4))
+    x[1:3, 1:3] = 7.0
+    assert x.asnumpy()[1, 1] == 7.0 and x.asnumpy()[0, 0] == 0.0
+    row = x[2]
+    row += 1.0
+    assert np.allclose(x.asnumpy()[2], [1, 8, 8, 1])
+
+
+# --------------------------------------------------------------------------
+# op-config matrices: Pooling, Deconvolution, BatchNorm, reductions
+# --------------------------------------------------------------------------
+def _np_pool(x, k, s, p, mode, count_include_pad=True):
+    n, c, h, w = x.shape
+    xo = np.pad(x, ((0, 0), (0, 0), (p, p), (p, p)),
+                constant_values=-np.inf if mode == "max" else np.nan)
+    oh = (h + 2 * p - k) // s + 1
+    ow = (w + 2 * p - k) // s + 1
+    out = np.zeros((n, c, oh, ow), "f")
+    for i in range(oh):
+        for j in range(ow):
+            win = xo[:, :, i * s:i * s + k, j * s:j * s + k]
+            if mode == "max":
+                out[:, :, i, j] = win.max((2, 3))
+            else:
+                filled = np.where(np.isnan(win), 0, win)
+                if count_include_pad:
+                    out[:, :, i, j] = filled.sum((2, 3)) / (k * k)
+                else:
+                    cnt = (~np.isnan(win)).sum((2, 3))
+                    out[:, :, i, j] = filled.sum((2, 3)) / cnt
+    return out
+
+
+@pytest.mark.parametrize("mode", ["max", "avg"])
+@pytest.mark.parametrize("k,s,p", [(2, 2, 0), (3, 2, 1), (3, 1, 1)])
+def test_pooling_config_matrix(mode, k, s, p):
+    rs = np.random.RandomState(6)
+    x = rs.randn(2, 3, 8, 9).astype("f")
+    out = nd.Pooling(nd.array(x), kernel=(k, k), stride=(s, s), pad=(p, p),
+                     pool_type=mode)
+    ref = _np_pool(x, k, s, p, mode)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_avg_pool_count_exclude_pad():
+    rs = np.random.RandomState(7)
+    x = rs.randn(1, 2, 6, 6).astype("f")
+    out = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                     pool_type="avg", count_include_pad=False)
+    ref = _np_pool(x, 3, 2, 1, "avg", count_include_pad=False)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deconvolution_value_vs_manual():
+    """Deconv == scatter-accumulate oracle (stride 2, k 3)."""
+    rs = np.random.RandomState(8)
+    x = rs.randn(1, 2, 3, 3).astype("f")
+    w = rs.randn(2, 4, 3, 3).astype("f")  # (in, out, kh, kw)
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           stride=(2, 2), num_filter=4, no_bias=True)
+    n, ci, h, wd = x.shape
+    oh = (h - 1) * 2 + 3
+    ow = (wd - 1) * 2 + 3
+    ref = np.zeros((1, 4, oh, ow), "f")
+    for i in range(h):
+        for j in range(wd):
+            for c in range(ci):
+                ref[0, :, i * 2:i * 2 + 3, j * 2:j * 2 + 3] += \
+                    x[0, c, i, j] * w[c]
+    assert out.shape == ref.shape
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_config_matrix():
+    rs = np.random.RandomState(9)
+    x = rs.randn(4, 3, 5, 5).astype("f")
+    gamma = rs.rand(3).astype("f") + 0.5
+    beta = rs.randn(3).astype("f")
+    mean = rs.randn(3).astype("f")
+    var = rs.rand(3).astype("f") + 0.5
+    # inference with global stats
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mean), nd.array(var), fix_gamma=False,
+                       use_global_stats=True)[0]
+    ref = (x - mean[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + 1e-5) * gamma[None, :, None, None] + \
+        beta[None, :, None, None]
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+    # fix_gamma forces scale 1
+    out2 = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                        nd.array(mean), nd.array(var), fix_gamma=True,
+                        use_global_stats=True)[0]
+    ref2 = (x - mean[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + 1e-5) + beta[None, :, None, None]
+    assert_almost_equal(out2, ref2, rtol=1e-4, atol=1e-4)
+
+
+def test_reduction_dtype_behavior():
+    a = np.arange(10, dtype="int32")
+    assert nd.array(a, dtype="int32").sum().dtype == np.dtype("int32")
+    b = nd.array(a, dtype="float16").sum()
+    assert b.dtype == np.dtype("float16")
+    assert float(b.asscalar()) == 45.0
+
+
+def test_rnn_cell_unroll_matches_manual_recurrence():
+    from mxnet_tpu.gluon import rnn
+
+    rs = np.random.RandomState(10)
+    cell = rnn.RNNCell(4, activation="tanh", input_size=3)
+    cell.initialize()
+    x = mx.nd.array(rs.randn(2, 5, 3).astype("f"))
+    outputs, state = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    p = {k.split("_", 1)[-1] if "_" in k else k: v.data().asnumpy()
+         for k, v in cell.collect_params().items()}
+    names = list(cell.collect_params())
+    i2h_w = [v for k, v in zip(names, p.values()) if "i2h_weight" in k][0]
+    i2h_b = [v for k, v in zip(names, p.values()) if "i2h_bias" in k][0]
+    h2h_w = [v for k, v in zip(names, p.values()) if "h2h_weight" in k][0]
+    h2h_b = [v for k, v in zip(names, p.values()) if "h2h_bias" in k][0]
+    xn = x.asnumpy()
+    h = np.zeros((2, 4), "f")
+    for t in range(5):
+        h = np.tanh(xn[:, t] @ i2h_w.T + i2h_b + h @ h2h_w.T + h2h_b)
+    assert_almost_equal(outputs.asnumpy()[:, -1], h, rtol=1e-4, atol=1e-4)
