@@ -31,7 +31,7 @@ case "$LANE" in
     JAX_PLATFORMS=cpu python -m pytest tests/test_distributed.py -q
     ;;
   bench)
-    python bench.py
+    python bench.py | tee BENCH.json
     ;;
   *)
     echo "unknown lane: $LANE (unit|tpu|dist|sanity|bench)" >&2

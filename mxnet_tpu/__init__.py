@@ -54,6 +54,7 @@ from . import symbol as sym
 from .executor import Executor
 from . import module
 from . import module as mod
+from . import rnn
 from . import operator
 from . import model
 from . import gluon
@@ -70,6 +71,8 @@ from . import runtime
 from . import callback
 from . import monitor
 from . import subgraph
+from . import numpy as np  # mx.np — NumPy-compatible namespace
+from . import numpy_extension as npx
 from . import env
 
 env.apply_env()

@@ -1,0 +1,93 @@
+"""mx.np — the NumPy-compatible array namespace.
+
+Reference: ``python/mxnet/numpy/`` (the 1.6+ ``mx.np`` experimental
+namespace: NumPy semantics — zero-dim shapes, NumPy broadcasting/naming —
+over the same engine; SURVEY.md §9 item 3).
+
+TPU-native: the namespace is *delegated*, not re-implemented.  Every
+``mx.np.<fn>`` resolves to ``jax.numpy.<fn>`` at call time (PEP 562 module
+getattr) and runs through ``apply_fn``, so results are framework NDArrays
+and gradients flow on the autograd tape exactly like registry ops.  This
+gives the full jax.numpy surface — einsum, linspace, meshgrid, fancy
+indexing helpers — with zero per-op code.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..ndarray.ndarray import NDArray, apply_fn
+
+__all__ = ["ndarray", "array", "empty"]
+
+ndarray = NDArray  # mx.np.ndarray is the same array type (numpy semantics
+#                    — zero-dim shapes etc. — are native to the jax backing)
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def array(obj, dtype=None, ctx=None):
+    v = obj._get() if isinstance(obj, NDArray) else _onp.asarray(obj)
+    out = _jnp().asarray(v, dtype=dtype)
+    return NDArray._from_jax(out, ctx)
+
+
+def empty(shape, dtype="float32", ctx=None):
+    return NDArray._from_jax(_jnp().zeros(shape, dtype), ctx)
+
+
+def _wrap_fn(fn, name):
+    def wrapped(*args, **kwargs):
+        nd_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+        nd_kw = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
+        nd_args = [args[i] for i in nd_pos] + [kwargs[k] for k in nd_kw]
+
+        def pure(*vals):
+            full = list(args)
+            kw = dict(kwargs)
+            for i, v in zip(nd_pos, vals[:len(nd_pos)]):
+                full[i] = v
+            for k, v in zip(nd_kw, vals[len(nd_pos):]):
+                kw[k] = v
+            return fn(*full, **kw)
+
+        if nd_args:
+            return apply_fn(pure, nd_args, name=f"np.{name}")
+        out = fn(*args, **kwargs)
+        if hasattr(out, "shape") and hasattr(out, "dtype"):
+            return NDArray._from_jax(_jnp().asarray(out), None)
+        if isinstance(out, (tuple, list)):
+            return type(out)(
+                NDArray._from_jax(o, None)
+                if hasattr(o, "shape") and hasattr(o, "dtype") else o
+                for o in out)
+        return out
+
+    wrapped.__name__ = name
+    wrapped.__qualname__ = name
+    wrapped.__doc__ = fn.__doc__
+    return wrapped
+
+
+_CACHE = {}
+
+
+def __getattr__(name):
+    if name.startswith("_"):
+        raise AttributeError(name)
+    if name in _CACHE:
+        return _CACHE[name]
+    jnp = _jnp()
+    target = getattr(jnp, name, None)
+    if target is None:
+        raise AttributeError(f"mx.np has no attribute {name!r} "
+                             "(not in jax.numpy)")
+    if callable(target) and not isinstance(target, type):
+        out = _wrap_fn(target, name)
+    else:
+        out = target  # dtypes (np.float32), constants (np.pi, np.inf)
+    _CACHE[name] = out
+    return out

@@ -1,0 +1,100 @@
+"""mx.npx — operator extensions for the NumPy namespace.
+
+Reference: ``python/mxnet/numpy_extension/`` (``mx.npx``: the neural-net
+operators and mode switches that NumPy itself has no name for).  Delegates
+to the shared op registry, so ``npx.softmax`` etc. are the exact kernels
+``mx.nd`` uses.
+"""
+from __future__ import annotations
+
+from ..ndarray.ndarray import NDArray, invoke
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
+           "softmax", "log_softmax", "relu", "sigmoid", "activation",
+           "one_hot", "pick", "topk", "batch_dot", "gamma", "erf",
+           "gelu", "leaky_relu"]
+
+_np_array = False
+_np_shape = False
+
+
+def set_np(shape=True, array=True):
+    """Enable/disable numpy semantics (reference: npx.set_np — the flags
+    deactivate when passed False).  Zero-dim shapes and numpy broadcasting
+    are native to this build, so the switch records intent for scripts that
+    query it."""
+    global _np_array, _np_shape
+    _np_array = bool(array)
+    _np_shape = bool(shape)
+
+
+def reset_np():
+    set_np(shape=False, array=False)
+
+
+def is_np_array():
+    return _np_array
+
+
+def is_np_shape():
+    return _np_shape
+
+
+def _op(opname, *args, **attrs):
+    # invoke() coerces raw numpy/list inputs itself — pass everything through
+    return invoke(opname, list(args), attrs)
+
+
+def softmax(data, axis=-1):
+    return _op("softmax", data, axis=axis)
+
+
+def log_softmax(data, axis=-1):
+    return _op("log_softmax", data, axis=axis)
+
+
+def relu(data):
+    return _op("relu", data)
+
+
+def sigmoid(data):
+    return _op("sigmoid", data)
+
+
+def gelu(data):
+    return _op("LeakyReLU", data, act_type="gelu")
+
+
+def leaky_relu(data, slope=0.25):
+    return _op("LeakyReLU", data, act_type="leaky", slope=slope)
+
+
+def activation(data, act_type="relu"):
+    return _op("Activation", data, act_type=act_type)
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return _op("one_hot", data, depth=depth, on_value=on_value,
+               off_value=off_value, dtype=dtype)
+
+
+def pick(data, index, axis=-1, keepdims=False):
+    return invoke("pick", [data, index], {"axis": axis, "keepdims": keepdims})
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+    return _op("topk", data, k=k, axis=axis, ret_typ=ret_typ,
+               is_ascend=is_ascend)
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    return invoke("batch_dot", [a, b], {"transpose_a": transpose_a,
+                                        "transpose_b": transpose_b})
+
+
+def gamma(data):
+    return _op("gamma", data)
+
+
+def erf(data):
+    return _op("erf", data)

@@ -130,20 +130,22 @@ def deconvolution(x, weight, *maybe_bias, kernel=None, stride=None, dilate=None,
     padding = [(d * (k - 1) - p, d * (k - 1) - p + a)
                for k, p, d, a in zip(weight.shape[2:], pads, dil, adjs)]
 
-    def one_group(xg, wg):
-        dn = lax.conv_dimension_numbers(xg.shape, wg.shape, dn_str)
-        return lax.conv_general_dilated(
-            xg, wg, window_strides=(1,) * nd, padding=padding,
-            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
-
-    if num_group == 1:
-        y = one_group(x, w_flip)
-    else:
-        cin = x.shape[1] // num_group
-        ys = [one_group(x[:, g * cin:(g + 1) * cin],
-                        w_flip[g * cin:(g + 1) * cin])
-              for g in range(num_group)]
-        y = jnp.concatenate(ys, axis=1)
+    if num_group > 1:
+        # one grouped conv instead of a per-group python loop: reorder
+        # (in, out/g, *k) -> (in/g, out, *k) so XLA's native grouped-conv
+        # kernel handles the partitioning (group gi of the lhs channels
+        # maps to output block gi, matching the reference's layout)
+        g = num_group
+        cin_g = w_flip.shape[0] // g
+        og = w_flip.shape[1]
+        w_flip = w_flip.reshape((g, cin_g, og) + w_flip.shape[2:])
+        w_flip = jnp.swapaxes(w_flip, 0, 1)
+        w_flip = w_flip.reshape((cin_g, g * og) + w_flip.shape[3:])
+    dn = lax.conv_dimension_numbers(x.shape, w_flip.shape, dn_str)
+    y = lax.conv_general_dilated(
+        x, w_flip, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+        feature_group_count=num_group)
     if not no_bias and maybe_bias:
         y = y + maybe_bias[0].reshape((1, -1) + (1,) * nd)
     return y

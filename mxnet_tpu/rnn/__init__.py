@@ -1,0 +1,313 @@
+"""mx.rnn — the legacy symbol-level RNN cell API.
+
+Reference: ``python/mxnet/rnn/rnn_cell.py`` (BaseRNNCell/RNNCell/LSTMCell/
+GRUCell/SequentialRNNCell + unroll — the API the BucketingModule language
+-model examples are written against; SURVEY.md §3.2 RNN row).  The Gluon
+cells (`mx.gluon.rnn`) are the imperative successors; these stage Symbol
+graphs so `mx.mod.BucketingModule` scripts keep working.
+
+TPU note: an unrolled cell graph jits into one XLA program per bucket
+length — the same compiled-once-per-bucket behavior the reference's
+BucketingModule executors had.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import symbol as _sym
+
+__all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BucketSentenceIter"]
+
+
+class BaseRNNCell:
+    """Abstract RNN cell over Symbols (reference: rnn_cell.BaseRNNCell)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+        self._counter = 0
+
+    def _get_param(self, name):
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = _sym.var(full)
+        return self._params[full]
+
+    @property
+    def params(self):
+        return dict(self._params)
+
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    def reset(self):
+        self._counter = 0
+
+    def begin_state(self, func=None, **kwargs):
+        """Zero initial states, shaped off the data symbol at unroll time.
+
+        The reference builds ``sym.zeros`` with deferred shapes; here the
+        states are materialized inside :meth:`unroll` from the first input
+        (``zeros_like``-style), so ``begin_state()`` returns placeholders
+        that unroll recognizes."""
+        return [None] * len(self.state_info)
+
+    def _zero_states(self, in_sym):
+        # zeros_like -> slice -> tile: pure shape plumbing, so inf/NaN in
+        # the data cannot poison the initial state (sum(x)*0 would)
+        z = _sym.slice_axis(_sym.zeros_like(in_sym), axis=-1, begin=0, end=1)
+        return [_sym.tile(z, reps=(1, info["num_hidden"]))
+                for info in self.state_info]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll ``length`` steps (reference: BaseRNNCell.unroll).
+
+        inputs: a Symbol of shape (N, T, C) for layout 'NTC' (or (T, N, C)
+        for 'TNC'), or a list of per-step symbols."""
+        self.reset()
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) != length:
+                raise MXNetError(f"unroll: got {len(inputs)} input symbols "
+                                 f"for length {length}")
+            seq = list(inputs)
+        else:
+            axis = 1 if layout == "NTC" else 0
+            seq = [_sym.squeeze(
+                _sym.slice_axis(inputs, axis=axis, begin=t, end=t + 1),
+                axis=axis) for t in range(length)]
+        states = begin_state
+        if states is None or any(s is None for s in states):
+            states = self._zero_states(seq[0])
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if merge_outputs:
+            outputs = _sym.Concat(
+                *[_sym.expand_dims(o, axis=1) for o in outputs], dim=1)
+        return outputs, states
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla tanh/relu cell (reference: rnn_cell.RNNCell)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_"):
+        super().__init__(prefix)
+        self._num_hidden = num_hidden
+        self._activation = activation
+
+    @property
+    def state_info(self):
+        return [{"num_hidden": self._num_hidden}]
+
+    def __call__(self, inputs, states):
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = _sym.FullyConnected(inputs, self._get_param("i2h_weight"),
+                                  self._get_param("i2h_bias"),
+                                  num_hidden=self._num_hidden,
+                                  name=name + "i2h")
+        h2h = _sym.FullyConnected(states[0], self._get_param("h2h_weight"),
+                                  self._get_param("h2h_bias"),
+                                  num_hidden=self._num_hidden,
+                                  name=name + "h2h")
+        out = _sym.Activation(i2h + h2h, act_type=self._activation,
+                              name=name + "out")
+        self._counter += 1
+        return out, [out]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM (reference: rnn_cell.LSTMCell — gate order i, f, c, o)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", forget_bias=1.0):
+        super().__init__(prefix)
+        self._num_hidden = num_hidden
+        self._forget_bias = forget_bias
+
+    @property
+    def state_info(self):
+        return [{"num_hidden": self._num_hidden},
+                {"num_hidden": self._num_hidden}]
+
+    def __call__(self, inputs, states):
+        name = f"{self._prefix}t{self._counter}_"
+        nh = self._num_hidden
+        i2h = _sym.FullyConnected(inputs, self._get_param("i2h_weight"),
+                                  self._get_param("i2h_bias"),
+                                  num_hidden=nh * 4, name=name + "i2h")
+        h2h = _sym.FullyConnected(states[0], self._get_param("h2h_weight"),
+                                  self._get_param("h2h_bias"),
+                                  num_hidden=nh * 4, name=name + "h2h")
+        gates = i2h + h2h
+        sliced = _sym.SliceChannel(gates, num_outputs=4, axis=1,
+                                   name=name + "slice")
+        in_gate = _sym.Activation(sliced[0], act_type="sigmoid")
+        forget_gate = _sym.Activation(sliced[1] + self._forget_bias,
+                                      act_type="sigmoid")
+        in_trans = _sym.Activation(sliced[2], act_type="tanh")
+        out_gate = _sym.Activation(sliced[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        next_h = out_gate * _sym.Activation(next_c, act_type="tanh")
+        self._counter += 1
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU (reference: rnn_cell.GRUCell — gate order r, z, n)."""
+
+    def __init__(self, num_hidden, prefix="gru_"):
+        super().__init__(prefix)
+        self._num_hidden = num_hidden
+
+    @property
+    def state_info(self):
+        return [{"num_hidden": self._num_hidden}]
+
+    def __call__(self, inputs, states):
+        name = f"{self._prefix}t{self._counter}_"
+        nh = self._num_hidden
+        i2h = _sym.FullyConnected(inputs, self._get_param("i2h_weight"),
+                                  self._get_param("i2h_bias"),
+                                  num_hidden=nh * 3, name=name + "i2h")
+        h2h = _sym.FullyConnected(states[0], self._get_param("h2h_weight"),
+                                  self._get_param("h2h_bias"),
+                                  num_hidden=nh * 3, name=name + "h2h")
+        i2h_s = _sym.SliceChannel(i2h, num_outputs=3, axis=1,
+                                  name=name + "i2h_slice")
+        h2h_s = _sym.SliceChannel(h2h, num_outputs=3, axis=1,
+                                  name=name + "h2h_slice")
+        reset = _sym.Activation(i2h_s[0] + h2h_s[0], act_type="sigmoid")
+        update = _sym.Activation(i2h_s[1] + h2h_s[1], act_type="sigmoid")
+        cand = _sym.Activation(i2h_s[2] + reset * h2h_s[2], act_type="tanh")
+        next_h = update * states[0] + (1.0 - update) * cand
+        self._counter += 1
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stacked cells (reference: rnn_cell.SequentialRNNCell)."""
+
+    def __init__(self):
+        super().__init__("")
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        return self
+
+    @property
+    def params(self):
+        out = {}
+        for c in self._cells:
+            out.update(c.params)
+        return out
+
+    @property
+    def state_info(self):
+        return [i for c in self._cells for i in c.state_info]
+
+    def reset(self):
+        for c in self._cells:
+            c.reset()
+
+    def __call__(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            inputs, s = cell(inputs, states[p:p + n])
+            next_states.extend(s)
+            p += n
+        return inputs, next_states
+
+
+class BucketSentenceIter:
+    """Bucketed sentence iterator (reference: python/mxnet/rnn/io.py
+    BucketSentenceIter — pads each sentence to its bucket length and yields
+    DataBatch with ``bucket_key`` for BucketingModule).
+
+    sentences: list of lists of int token ids.
+    """
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 layout="NT"):
+        self.layout = layout
+        import numpy as np
+
+        if buckets is None:
+            lens = np.bincount([len(s) for s in sentences])
+            buckets = [i for i, n in enumerate(lens)
+                       if n >= batch_size and i > 0]
+            if not buckets:
+                buckets = [max(len(s) for s in sentences)]
+        self.buckets = sorted(buckets)
+        self.batch_size = batch_size
+        self.invalid_label = invalid_label
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.data = [[] for _ in self.buckets]
+        ndiscard = 0
+        for s in sentences:
+            buck = next((i for i, b in enumerate(self.buckets)
+                         if b >= len(s)), None)
+            if buck is None:
+                ndiscard += 1
+                continue
+            row = np.full((self.buckets[buck],), invalid_label, dtype=dtype)
+            row[:len(s)] = s
+            self.data[buck].append(row)
+        self.data = [np.asarray(rows, dtype=dtype) if rows else
+                     np.zeros((0, b), dtype=dtype)
+                     for rows, b in zip(self.data, self.buckets)]
+        self.ndiscard = ndiscard
+        self.default_bucket_key = max(self.buckets)
+        shape = (batch_size, self.default_bucket_key) if layout == "NT" \
+            else (self.default_bucket_key, batch_size)
+        self.provide_data = [(data_name, shape)]
+        self.provide_label = [(label_name, shape)]
+        self.reset()
+
+    def reset(self):
+        import numpy as np
+
+        self._idx = [(i, j) for i, rows in enumerate(self.data)
+                     for j in range(0, len(rows) - self.batch_size + 1,
+                                    self.batch_size)]
+        np.random.shuffle(self._idx)
+        for rows in self.data:
+            np.random.shuffle(rows)
+        self._cur = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        from ..io import DataBatch
+        from ..ndarray import array
+        import numpy as np
+
+        if self._cur >= len(self._idx):
+            raise StopIteration
+        i, j = self._idx[self._cur]
+        self._cur += 1
+        d = self.data[i][j:j + self.batch_size]
+        # label = data shifted one step left (next-token prediction),
+        # trailing slot filled with invalid_label (reference semantics)
+        lab = np.full_like(d, self.invalid_label)
+        lab[:, :-1] = d[:, 1:]
+        if self.layout == "TN":
+            d, lab = d.T, lab.T
+        return DataBatch(data=[array(d)], label=[array(lab)],
+                         bucket_key=self.buckets[i],
+                         provide_data=[(self.data_name, d.shape)],
+                         provide_label=[(self.label_name, lab.shape)])

@@ -1,0 +1,99 @@
+"""mx.np / mx.npx namespaces (reference:
+tests/python/unittest/test_numpy_op.py, test_numpy_ndarray.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_np_creation_and_elemwise():
+    a = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.np.ones((2, 2))
+    c = mx.np.add(a, b)
+    assert isinstance(c, mx.nd.NDArray)
+    assert onp.allclose(c.asnumpy(), [[2, 3], [4, 5]])
+    z = mx.np.zeros((0, 3))
+    assert z.shape == (0, 3)
+    assert mx.np.linspace(0, 1, 5).shape == (5,)
+
+
+def test_np_zero_dim_shape():
+    s = mx.np.array(2.5)
+    assert s.shape == ()
+    assert float(mx.np.sqrt(s).asnumpy()) == onp.sqrt(2.5).astype("f")
+
+
+def test_np_einsum_and_reductions():
+    rs = onp.random.RandomState(0)
+    a = rs.randn(3, 4).astype("f")
+    b = rs.randn(4, 5).astype("f")
+    out = mx.np.einsum("ij,jk->ik", mx.np.array(a), mx.np.array(b))
+    assert onp.allclose(out.asnumpy(), a @ b, atol=1e-5)
+    m = mx.np.mean(mx.np.array(a), axis=0)
+    assert onp.allclose(m.asnumpy(), a.mean(0), atol=1e-6)
+
+
+def test_np_autograd_flows():
+    x = mx.np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.np.sum(mx.np.exp(x) * 2.0)
+    y.backward()
+    assert onp.allclose(x.grad.asnumpy(), 2 * onp.exp([1, 2, 3]), rtol=1e-5)
+
+
+def test_np_multi_output():
+    parts = mx.np.split(mx.np.arange(12).reshape(3, 4), 2, axis=1)
+    assert len(parts) == 2
+    assert parts[0].shape == (3, 2)
+
+
+def test_np_kwarg_ndarray_input():
+    cond = mx.np.array([True, False, True])
+    out = mx.np.where(cond, mx.np.array([1.0, 1, 1]),
+                      mx.np.array([9.0, 9, 9]))
+    assert onp.allclose(out.asnumpy(), [1, 9, 1])
+
+
+def test_np_constants_and_dtypes():
+    assert abs(mx.np.pi - onp.pi) < 1e-9
+    assert mx.np.float32 is not None
+
+
+def test_npx_ops_and_mode():
+    x = mx.np.array([[1.0, 2.0, 3.0]])
+    s = mx.npx.softmax(x)
+    e = onp.exp([1, 2, 3])
+    assert onp.allclose(s.asnumpy(), e / e.sum(), rtol=1e-5)
+    r = mx.npx.relu(mx.np.array([-1.0, 2.0]))
+    assert onp.allclose(r.asnumpy(), [0, 2])
+    assert not mx.npx.is_np_array()
+    mx.npx.set_np()
+    assert mx.npx.is_np_array() and mx.npx.is_np_shape()
+    mx.npx.reset_np()
+    assert not mx.npx.is_np_array()
+
+
+def test_npx_one_hot_topk():
+    oh = mx.npx.one_hot(mx.np.array([0, 2]).astype("int32"), 3)
+    assert onp.allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+    tk = mx.npx.topk(mx.np.array([[0.1, 0.9, 0.5]]), k=2)
+    assert tk.asnumpy().astype(int).tolist()[0] == [1, 2]
+
+
+def test_npx_accepts_raw_numpy_inputs():
+    """npx ops coerce raw numpy/list inputs like mx.nd does (review
+    finding: they were silently dropped)."""
+    s = mx.npx.softmax(onp.array([[1.0, 2.0, 3.0]], "f"))
+    e = onp.exp([1, 2, 3])
+    assert onp.allclose(s.asnumpy(), e / e.sum(), rtol=1e-5)
+    r = mx.npx.relu([-1.0, 2.0])
+    assert onp.allclose(r.asnumpy(), [0, 2])
+
+
+def test_npx_set_np_flags_honored():
+    mx.npx.set_np(shape=True, array=False)
+    assert mx.npx.is_np_shape() and not mx.npx.is_np_array()
+    mx.npx.set_np(shape=False, array=False)
+    assert not mx.npx.is_np_shape() and not mx.npx.is_np_array()
+    mx.npx.reset_np()

@@ -282,3 +282,27 @@ def test_rnn_cell_unroll_matches_manual_recurrence():
     for t in range(5):
         h = np.tanh(xn[:, t] @ i2h_w.T + i2h_b + h @ h2h_w.T + h2h_b)
     assert_almost_equal(outputs.asnumpy()[:, -1], h, rtol=1e-4, atol=1e-4)
+
+
+def test_grouped_deconvolution_vs_manual():
+    """num_group > 1 transposed conv == per-group scatter oracle (lowered
+    as ONE grouped conv, not a python loop)."""
+    rs = np.random.RandomState(11)
+    g, cin_g, cout_g = 2, 2, 3
+    x = rs.randn(1, g * cin_g, 3, 3).astype("f")
+    w = rs.randn(g * cin_g, cout_g, 3, 3).astype("f")
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           stride=(2, 2), num_filter=g * cout_g,
+                           num_group=g, no_bias=True)
+    oh = (3 - 1) * 2 + 3
+    ref = np.zeros((1, g * cout_g, oh, oh), "f")
+    for gi in range(g):
+        for i in range(3):
+            for j in range(3):
+                for c in range(cin_g):
+                    ci = gi * cin_g + c
+                    ref[0, gi * cout_g:(gi + 1) * cout_g,
+                        i * 2:i * 2 + 3, j * 2:j * 2 + 3] += \
+                        x[0, ci, i, j] * w[ci]
+    assert out.shape == ref.shape
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)

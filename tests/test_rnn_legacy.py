@@ -1,0 +1,144 @@
+"""mx.rnn legacy symbol-level cells (reference:
+tests/python/unittest/test_rnn.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _bind_forward(out_syms, feed_shapes, seed=0):
+    sym = out_syms if isinstance(out_syms, mx.sym.Symbol) else \
+        mx.sym.Group(out_syms)
+    rs = np.random.RandomState(seed)
+    shapes, _, _ = sym.infer_shape(**feed_shapes)
+    feed = {}
+    for name, shp in zip(sym.list_arguments(), shapes):
+        feed[name] = mx.nd.array(rs.randn(*shp).astype("f") * 0.1)
+    ex = sym.bind(mx.cpu(), feed)
+    return ex.forward(), feed
+
+
+def test_rnn_cell_unroll_matches_numpy():
+    cell = mx.rnn.RNNCell(6, prefix="r_")
+    data = mx.sym.var("data")
+    outputs, states = cell.unroll(3, data, merge_outputs=True)
+    outs, feed = _bind_forward(outputs, {"data": (2, 3, 4)})
+    x = feed["data"].asnumpy()
+    wi = feed["r_i2h_weight"].asnumpy()
+    bi = feed["r_i2h_bias"].asnumpy()
+    wh = feed["r_h2h_weight"].asnumpy()
+    bh = feed["r_h2h_bias"].asnumpy()
+    h = np.zeros((2, 6), "f")
+    hs = []
+    for t in range(3):
+        h = np.tanh(x[:, t] @ wi.T + bi + h @ wh.T + bh)
+        hs.append(h)
+    ref = np.stack(hs, axis=1)
+    assert np.allclose(outs[0].asnumpy(), ref, atol=1e-5)
+
+
+def test_lstm_cell_shapes_and_finiteness():
+    cell = mx.rnn.LSTMCell(8, prefix="l_")
+    outputs, states = cell.unroll(4, mx.sym.var("data"), merge_outputs=True)
+    outs, _ = _bind_forward([outputs] + states, {"data": (3, 4, 5)})
+    assert outs[0].shape == (3, 4, 8)
+    assert outs[1].shape == (3, 8) and outs[2].shape == (3, 8)
+    for o in outs:
+        assert np.isfinite(o.asnumpy()).all()
+
+
+def test_gru_cell_unroll_list_inputs():
+    cell = mx.rnn.GRUCell(5, prefix="g_")
+    ins = [mx.sym.var(f"x{t}") for t in range(2)]
+    outputs, states = cell.unroll(2, ins)
+    outs, _ = _bind_forward(outputs, {"x0": (2, 3), "x1": (2, 3)})
+    assert outs[0].shape == (2, 5) and outs[1].shape == (2, 5)
+
+
+def test_sequential_stack_and_param_sharing():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(6, prefix="s0_"))
+    stack.add(mx.rnn.RNNCell(4, prefix="s1_"))
+    outputs, states = stack.unroll(3, mx.sym.var("data"), merge_outputs=True)
+    outs, feed = _bind_forward(outputs, {"data": (2, 3, 5)})
+    assert outs[0].shape == (2, 3, 4)
+    # unrolled steps share one parameter set per cell
+    names = [n for n in feed if "weight" in n or "bias" in n]
+    assert sorted(names) == sorted(set(names))
+    assert len([n for n in names if n.startswith("s0_")]) == 4
+    assert len([n for n in names if n.startswith("s1_")]) == 4
+
+
+def test_rnn_cell_with_bucketing_module():
+    """The reference workflow: cell.unroll inside a BucketingModule
+    sym_gen (reference: example/rnn bucketing)."""
+    def sym_gen(seq_len):
+        cell = mx.rnn.RNNCell(4, prefix="b_")
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        outputs, _ = cell.unroll(seq_len, data, merge_outputs=True)
+        last = mx.sym.slice_axis(outputs, axis=1, begin=seq_len - 1,
+                                 end=seq_len)
+        fc = mx.sym.FullyConnected(mx.sym.squeeze(last, axis=1),
+                                   num_hidden=3, name="fc")
+        return mx.sym.SoftmaxOutput(fc, label, name="softmax"), \
+            ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=5)
+    mod.bind(data_shapes=[("data", (2, 5, 3))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    batch = mx.io.DataBatch(data=[mx.nd.ones((2, 5, 3))],
+                            label=[mx.nd.zeros((2,))],
+                            bucket_key=5)
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (2, 3)
+    assert np.allclose(out.asnumpy().sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_bucket_sentence_iter():
+    rs = np.random.RandomState(0)
+    sents = [list(rs.randint(1, 50, rs.randint(2, 9))) for _ in range(40)]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[4, 8],
+                                   invalid_label=0)
+    seen = 0
+    for batch in it:
+        d = batch.data[0].asnumpy()
+        lab = batch.label[0].asnumpy()
+        assert d.shape == (4, batch.bucket_key)
+        assert np.allclose(lab[:, :-1], d[:, 1:])
+        assert (lab[:, -1] == 0).all()
+        seen += 1
+    assert seen > 0
+    it.reset()
+    assert len(list(it)) == seen
+
+
+def test_bucket_sentence_iter_tn_layout():
+    rs = np.random.RandomState(1)
+    sents = [list(rs.randint(1, 20, 4)) for _ in range(8)]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[4],
+                                   invalid_label=0, layout="TN")
+    assert it.provide_data[0][1] == (4, 4)
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 4)  # (T, N)
+    d = b.data[0].asnumpy()
+    lab = b.label[0].asnumpy()
+    assert np.allclose(lab[:-1, :], d[1:, :])  # shift along TIME axis
+
+
+def test_rnn_unroll_inf_input_does_not_poison_state():
+    """Initial states are true zeros: inf in the data must not NaN the
+    whole unroll (review finding: sum(x)*0 state init)."""
+    cell = mx.rnn.RNNCell(3, prefix="z_")
+    outputs, _ = cell.unroll(2, mx.sym.var("data"), merge_outputs=True)
+    shapes, _, _ = outputs.infer_shape(data=(1, 2, 2))
+    feed = {}
+    rs = np.random.RandomState(2)
+    for name, shp in zip(outputs.list_arguments(), shapes):
+        feed[name] = mx.nd.array(rs.randn(*shp).astype("f") * 0.1)
+    d = feed["data"].asnumpy().copy()
+    d[0, 0, 0] = np.inf
+    feed["data"] = mx.nd.array(d)
+    out = outputs.bind(mx.cpu(), feed).forward()[0].asnumpy()
+    assert np.isfinite(out[0, 1]).all()  # t=1 saturates to +-1, not NaN
