@@ -590,3 +590,164 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     else:
         labelpad = (labels <= 0).astype(jnp.float32)  # 0 used as padding token
     return optax.ctc_loss(logits, logitpad, labels, labelpad)
+
+
+# ==========================================================================
+# Fused RNN op (reference: src/operator/rnn.cc "RNN" — the cuDNN-style
+# fused multi-layer recurrence with the FLAT parameter vector; the symbol
+# scripts' sym.RNN and mx.rnn.FusedRNNCell surface).
+# TPU-native: each layer/direction is a lax.scan whose i2h projection is
+# hoisted out of the loop as one big (T*N, ni)x(ni, G*nh) GEMM on the MXU;
+# only the h2h recurrence stays sequential.
+# ==========================================================================
+_RNN_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}
+
+
+def _rnn_param_layout(mode, input_size, state_size, num_layers, ndir):
+    """Yield (kind, shape) in the reference's flat layout: all weights
+    layer-major (i2h then h2h per direction), then all biases."""
+    g = _RNN_GATES[mode]
+    nh = state_size
+    shapes = []
+    for layer in range(num_layers):
+        ni = input_size if layer == 0 else nh * ndir
+        for _ in range(ndir):
+            shapes.append(("i2h_weight", (g * nh, ni)))
+            shapes.append(("h2h_weight", (g * nh, nh)))
+    for layer in range(num_layers):
+        for _ in range(ndir):
+            shapes.append(("i2h_bias", (g * nh,)))
+            shapes.append(("h2h_bias", (g * nh,)))
+    return shapes
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers=1,
+                   bidirectional=False):
+    """Total flat parameter count (reference: rnn-inl.h GetRnnParamSize)."""
+    ndir = 2 if bidirectional else 1
+    return sum(int(_np.prod(s)) for _, s in _rnn_param_layout(
+        mode, input_size, state_size, num_layers, ndir))
+
+
+def _rnn_scan_dir(jnp, mode, xs, h0, c0, wi, wh, bi, bh,
+                  clip_min=None, clip_max=None, clip_nan=False):
+    """xs (T, N, ni) -> (hs (T, N, nh), h_final, c_final|None)."""
+    import jax
+    from jax import nn as jnn
+
+    i2h_all = jnp.einsum("tni,gi->tng", xs, wi) + bi
+    if mode == "lstm":
+        def step(carry, i2h_t):
+            h_prev, c_prev = carry
+            gates = i2h_t + h_prev @ wh.T + bh
+            i, f, g_, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jnn.sigmoid(i), jnn.sigmoid(f), jnn.sigmoid(o)
+            c = f * c_prev + i * jnp.tanh(g_)
+            # reference rnn.cc clips the cell state EVERY step so the
+            # recurrence stays bounded, not just the returned final state
+            if clip_nan:
+                c = jnp.nan_to_num(c, nan=0.0)
+            if clip_min is not None or clip_max is not None:
+                c = jnp.clip(c, clip_min, clip_max)
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        (hf, cf), hs = jax.lax.scan(step, (h0, c0), i2h_all)
+        return hs, hf, cf
+    if mode == "gru":
+        def step(h_prev, i2h_t):
+            h2h = h_prev @ wh.T + bh
+            ir, iz, in_ = jnp.split(i2h_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(h2h, 3, axis=-1)
+            r = jnn.sigmoid(ir + hr)
+            z = jnn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            h = (1 - z) * n + z * h_prev
+            return h, h
+
+        hf, hs = jax.lax.scan(step, h0, i2h_all)
+        return hs, hf, None
+    act = (lambda v: jnp.maximum(v, 0)) if mode == "rnn_relu" else jnp.tanh
+
+    def step(h_prev, i2h_t):
+        h = act(i2h_t + h_prev @ wh.T + bh)
+        return h, h
+
+    hf, hs = jax.lax.scan(step, h0, i2h_all)
+    return hs, hf, None
+
+
+@register("RNN", aliases=("rnn",), nout="dynamic", needs_rng=True)
+def fused_rnn(rng_key, data, parameters, *maybe_states, state_size=None,
+              num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+              state_outputs=False, training=False, projection_size=None,
+              lstm_state_clip_min=None, lstm_state_clip_max=None,
+              lstm_state_clip_nan=False, use_sequence_length=False):
+    """data (T, N, C) [the reference's TNC layout], parameters: the flat
+    vector (see rnn_param_size), optional state (nl*nd, N, nh) and, for
+    lstm, state_cell.  Returns out, or (out, state_h[, state_cell]) when
+    state_outputs.  Dropout p applies between layers when training."""
+    jnp = _jnp()
+    if projection_size:
+        raise ValueError("RNN projection_size is not supported")
+    if use_sequence_length:
+        raise ValueError("RNN use_sequence_length is not supported; mask "
+                         "with SequenceMask or pad to full length")
+    T, N, C = data.shape
+    nh, nl = int(state_size), int(num_layers)
+    ndir = 2 if bidirectional else 1
+    layout = _rnn_param_layout(mode, C, nh, nl, ndir)
+    flat = parameters
+    pieces = []
+    off = 0
+    for _, shp in layout:
+        n = int(_np.prod(shp))
+        pieces.append(flat[off:off + n].reshape(shp))
+        off += n
+    if off != flat.shape[0]:
+        raise ValueError(
+            f"RNN: parameter vector has {flat.shape[0]} elements, layout "
+            f"needs {off} (mode={mode}, input={C}, hidden={nh}, "
+            f"layers={nl}, dirs={ndir})")
+    n_w = 2 * nl * ndir
+    weights = pieces[:n_w]
+    biases = pieces[n_w:]
+    states = list(maybe_states)
+    h_all = states[0] if states else jnp.zeros((nl * ndir, N, nh), data.dtype)
+    c_all = states[1] if mode == "lstm" and len(states) > 1 else \
+        jnp.zeros((nl * ndir, N, nh), data.dtype)
+    out = data
+    out_h, out_c = [], []
+    for layer in range(nl):
+        layer_outs = []
+        for d in range(ndir):
+            idx = layer * ndir + d
+            wi, wh = weights[2 * idx], weights[2 * idx + 1]
+            bi, bh = biases[2 * idx], biases[2 * idx + 1]
+            seq = out if d == 0 else jnp.flip(out, axis=0)
+            hs, hf, cf = _rnn_scan_dir(jnp, mode, seq, h_all[idx],
+                                       c_all[idx], wi, wh, bi, bh,
+                                       clip_min=lstm_state_clip_min,
+                                       clip_max=lstm_state_clip_max,
+                                       clip_nan=lstm_state_clip_nan)
+            if d == 1:
+                hs = jnp.flip(hs, axis=0)
+            layer_outs.append(hs)
+            out_h.append(hf)
+            if cf is not None:
+                out_c.append(cf)
+        out = layer_outs[0] if ndir == 1 else \
+            jnp.concatenate(layer_outs, axis=-1)
+        if p > 0 and training and layer < nl - 1:
+            from jax import random as jr
+
+            keep = 1.0 - p
+            key = jr.fold_in(rng_key, layer)
+            out = out * jr.bernoulli(key, keep, out.shape).astype(
+                out.dtype) / keep
+    if not state_outputs:
+        return out
+    outs = (out, jnp.stack(out_h, axis=0))
+    if mode == "lstm":
+        outs = outs + (jnp.stack(out_c, axis=0),)
+    return outs

@@ -16,7 +16,7 @@ from ..base import MXNetError
 from .. import symbol as _sym
 
 __all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "BucketSentenceIter"]
+           "SequentialRNNCell", "FusedRNNCell", "BucketSentenceIter"]
 
 
 class BaseRNNCell:
@@ -311,3 +311,82 @@ class BucketSentenceIter:
                          bucket_key=self.buckets[i],
                          provide_data=[(self.data_name, d.shape)],
                          provide_label=[(self.label_name, lab.shape)])
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer RNN over the flat parameter vector (reference:
+    rnn_cell.FusedRNNCell -> sym.RNN, src/operator/rnn.cc).  unroll()
+    stages ONE RNN node — on TPU that is one XLA program with the i2h
+    GEMMs hoisted out of the scan."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 prefix=None):
+        super().__init__(prefix if prefix is not None else f"{mode}_")
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+
+    @property
+    def state_info(self):
+        n = self._num_layers * (2 if self._bidirectional else 1)
+        infos = [{"num_hidden": self._num_hidden, "layers": n}]
+        if self._mode == "lstm":
+            infos.append({"num_hidden": self._num_hidden, "layers": n})
+        return infos
+
+    def __call__(self, inputs, states):
+        raise MXNetError("FusedRNNCell cannot step one timestep at a "
+                         "time; use unroll() (reference behavior)")
+
+    def _zero_fused_states(self, data_tnc):
+        """(nl*nd, N, nh) zero-state symbols shaped off the data — staged
+        explicitly so the op's state slots never become free trainable
+        variables (reference starts fused RNNs from zeros)."""
+        n = self._num_layers * (2 if self._bidirectional else 1)
+        z = _sym.slice_axis(_sym.zeros_like(data_tnc), axis=0, begin=0,
+                            end=1)                       # (1, N, C)
+        z = _sym.slice_axis(z, axis=-1, begin=0, end=1)  # (1, N, 1)
+        z = _sym.tile(z, reps=(n, 1, self._num_hidden))
+        return [z] * len(self.state_info)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) != length:
+                raise MXNetError(f"unroll: got {len(inputs)} input symbols "
+                                 f"for length {length}")
+            inputs = _sym.Concat(
+                *[_sym.expand_dims(s, axis=1) for s in inputs], dim=1)
+            layout = "NTC"
+        data = inputs if layout == "TNC" else \
+            _sym.swapaxes(inputs, dim1=0, dim2=1)
+        if begin_state is None:
+            begin_state = self._zero_fused_states(data)
+        elif any(s is None for s in begin_state):
+            raise MXNetError("begin_state must be a full list of state "
+                             "symbols (or None for zeros)")
+        args = [data, self._get_param("parameters")] + list(begin_state)
+        out = _sym.RNN(*args, state_size=self._num_hidden,
+                       num_layers=self._num_layers, mode=self._mode,
+                       bidirectional=self._bidirectional, p=self._dropout,
+                       state_outputs=self._get_next_state,
+                       name=self._prefix + "rnn")
+        if self._get_next_state:
+            states = [out[i] for i in range(1, 3 if self._mode == "lstm"
+                                            else 2)]
+            out = out[0]
+        else:
+            states = []
+        if layout == "NTC":
+            out = _sym.swapaxes(out, dim1=0, dim2=1)
+        if not merge_outputs:
+            axis = 1 if layout == "NTC" else 0
+            out = [_sym.squeeze(
+                _sym.slice_axis(out, axis=axis, begin=t, end=t + 1),
+                axis=axis) for t in range(length)]
+        return out, states

@@ -47,6 +47,8 @@ _OP_PARAM_VARS = {
     "LayerNorm": lambda a: ["gamma", "beta"],
     "GroupNorm": lambda a: ["gamma", "beta"],
     "InstanceNorm": lambda a: ["gamma", "beta"],
+    "RNN": lambda a: ["parameters", "state"] + (
+        ["state_cell"] if str(a.get("mode", "lstm")) == "lstm" else []),
 }
 
 
@@ -86,6 +88,17 @@ def _param_shape_hints(op, attrs, data_shape):
         ax = a.get("axis", -1) if op == "LayerNorm" else 1
         c = data_shape[ax]
         return {"gamma": (c,), "beta": (c,)}
+    if op == "RNN":
+        from ..ops.nn import rnn_param_size
+
+        nh = int(a["state_size"])
+        nl = int(a.get("num_layers", 1))
+        bi = _attr_true(a.get("bidirectional"))
+        ndir = 2 if bi else 1
+        t, n, c = data_shape  # TNC layout
+        total = rnn_param_size(str(a.get("mode", "lstm")), c, nh, nl, bi)
+        return {"parameters": (total,), "state": (nl * ndir, n, nh),
+                "state_cell": (nl * ndir, n, nh)}
     return {}
 
 
@@ -120,6 +133,16 @@ def _proposal_nout(attrs, nin):
 
 for _k in ("_contrib_Proposal", "Proposal", "proposal"):
     _DYNAMIC_NOUT[_k] = _proposal_nout
+
+
+def _rnn_nout(attrs, nin):
+    if not _attr_true(attrs.get("state_outputs")):
+        return 1
+    return 3 if str(attrs.get("mode", "lstm")) == "lstm" else 2
+
+
+for _k in ("RNN", "rnn"):
+    _DYNAMIC_NOUT[_k] = _rnn_nout
 
 
 class _NameManager(threading.local):

@@ -142,3 +142,97 @@ def test_rnn_unroll_inf_input_does_not_poison_state():
     feed["data"] = mx.nd.array(d)
     out = outputs.bind(mx.cpu(), feed).forward()[0].asnumpy()
     assert np.isfinite(out[0, 1]).all()  # t=1 saturates to +-1, not NaN
+
+
+def test_fused_rnn_op_matches_unfused_cells():
+    """sym.RNN (flat params, rnn_tanh) == step-by-step RNNCell unroll."""
+    from mxnet_tpu.ops.nn import rnn_param_size
+
+    rs = np.random.RandomState(3)
+    T, N, C, H = 4, 2, 3, 5
+    x = rs.randn(T, N, C).astype("f") * 0.5
+    wi = rs.randn(H, C).astype("f") * 0.3
+    wh = rs.randn(H, H).astype("f") * 0.3
+    bi = rs.randn(H).astype("f") * 0.1
+    bh = rs.randn(H).astype("f") * 0.1
+    flat = np.concatenate([wi.ravel(), wh.ravel(), bi, bh])
+    assert flat.size == rnn_param_size("rnn_tanh", C, H)
+    out = mx.nd.RNN(mx.nd.array(x), mx.nd.array(flat), state_size=H,
+                    num_layers=1, mode="rnn_tanh")
+    h = np.zeros((N, H), "f")
+    ref = []
+    for t in range(T):
+        h = np.tanh(x[t] @ wi.T + bi + h @ wh.T + bh)
+        ref.append(h)
+    assert np.allclose(out.asnumpy(), np.stack(ref), atol=1e-5)
+
+
+def test_fused_rnn_op_lstm_state_outputs():
+    from mxnet_tpu.ops.nn import rnn_param_size
+
+    rs = np.random.RandomState(4)
+    T, N, C, H, L = 3, 2, 4, 6, 2
+    x = rs.randn(T, N, C).astype("f")
+    flat = (rs.randn(rnn_param_size("lstm", C, H, L,
+                                    bidirectional=True)) * 0.1).astype("f")
+    out, hs, cs = mx.nd.RNN(mx.nd.array(x), mx.nd.array(flat), state_size=H,
+                            num_layers=L, mode="lstm", bidirectional=True,
+                            state_outputs=True)
+    assert out.shape == (T, N, 2 * H)
+    assert hs.shape == (2 * L, N, H) and cs.shape == (2 * L, N, H)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_fused_rnn_cell_symbolic():
+    cell = mx.rnn.FusedRNNCell(5, num_layers=2, mode="gru", prefix="f_")
+    outputs, _ = cell.unroll(4, mx.sym.var("data"), merge_outputs=True)
+    shapes, _, _ = outputs.infer_shape(data=(2, 4, 3))
+    feed = {}
+    rs = np.random.RandomState(5)
+    for name, shp in zip(outputs.list_arguments(), shapes):
+        feed[name] = mx.nd.array(rs.randn(*shp).astype("f") * 0.1)
+    y = outputs.bind(mx.cpu(), feed).forward()[0]
+    assert y.shape == (2, 4, 5)
+    assert np.isfinite(y.asnumpy()).all()
+
+
+def test_fused_cell_zero_states_not_trainable():
+    """Without begin_state, fused unroll stages zero states — no free
+    'state' variables appear as trainable arguments (review finding)."""
+    cell = mx.rnn.FusedRNNCell(4, mode="lstm", prefix="zz_")
+    outputs, _ = cell.unroll(3, mx.sym.var("data"), merge_outputs=True)
+    args = outputs.list_arguments()
+    assert not any("state" in a for a in args), args
+    shapes, _, _ = outputs.infer_shape(data=(2, 3, 3))
+    feed = {n: mx.nd.array(np.random.RandomState(6).randn(*s).astype("f")
+                           * 0.1)
+            for n, s in zip(args, shapes)}
+    y = outputs.bind(mx.cpu(), feed).forward()[0]
+    assert y.shape == (2, 3, 4)
+
+
+def test_fused_rnn_lstm_state_clip_per_step():
+    """Cell-state clipping bounds the recurrence at every step."""
+    from mxnet_tpu.ops.nn import rnn_param_size
+
+    T, N, C, H = 6, 1, 2, 3
+    x = mx.nd.ones((T, N, C)) * 100.0  # drives c upward every step
+    n = rnn_param_size("lstm", C, H)
+    flat = mx.nd.ones((n,)) * 0.5
+    out, hs, cs = mx.nd.RNN(x, flat, state_size=H, mode="lstm",
+                            state_outputs=True, lstm_state_clip_min=-0.25,
+                            lstm_state_clip_max=0.25)
+    assert np.abs(cs.asnumpy()).max() <= 0.25 + 1e-6
+    # h = o * tanh(c) stays within tanh(0.25)
+    assert np.abs(out.asnumpy()).max() <= np.tanh(0.25) + 1e-6
+
+
+def test_fused_rnn_use_sequence_length_raises():
+    import pytest
+
+    from mxnet_tpu.ops.nn import rnn_param_size
+
+    with pytest.raises(Exception):
+        mx.nd.RNN(mx.nd.ones((2, 1, 2)),
+                  mx.nd.ones((rnn_param_size("gru", 2, 3),)),
+                  state_size=3, mode="gru", use_sequence_length=True)
