@@ -365,11 +365,13 @@ class FusedRNNCell(BaseRNNCell):
             layout = "NTC"
         data = inputs if layout == "TNC" else \
             _sym.swapaxes(inputs, dim1=0, dim2=1)
-        if begin_state is None:
+        if begin_state is None or all(s is None for s in begin_state):
+            # None / the base begin_state() placeholder list = zero states
             begin_state = self._zero_fused_states(data)
         elif any(s is None for s in begin_state):
-            raise MXNetError("begin_state must be a full list of state "
-                             "symbols (or None for zeros)")
+            raise MXNetError("begin_state mixes symbols and None; pass a "
+                             "full list of state symbols (or None/"
+                             "begin_state() for zeros)")
         args = [data, self._get_param("parameters")] + list(begin_state)
         out = _sym.RNN(*args, state_size=self._num_hidden,
                        num_layers=self._num_layers, mode=self._mode,

@@ -794,7 +794,7 @@ def evaluate(heads, feed, rng_key=None, training=False, collect_state=False):
         od = get_op(n.op)
         in_vals = [vals[(id(inp), idx)] for inp, idx in n.inputs]
         attrs = _clean_attrs(n.attrs)
-        if training and n.op in ("BatchNorm", "Dropout"):
+        if training and n.op in ("BatchNorm", "Dropout", "RNN"):
             attrs["training"] = True
         if od.needs_rng:
             in_vals = [next_key()] + in_vals

@@ -236,3 +236,30 @@ def test_fused_rnn_use_sequence_length_raises():
         mx.nd.RNN(mx.nd.ones((2, 1, 2)),
                   mx.nd.ones((rnn_param_size("gru", 2, 3),)),
                   state_size=3, mode="gru", use_sequence_length=True)
+
+
+def test_fused_cell_begin_state_placeholder_idiom():
+    """cell.unroll(T, data, begin_state=cell.begin_state()) — the
+    documented reference idiom — yields zero states (review finding)."""
+    cell = mx.rnn.FusedRNNCell(4, mode="lstm", prefix="bs_")
+    outputs, _ = cell.unroll(3, mx.sym.var("data"),
+                             begin_state=cell.begin_state(),
+                             merge_outputs=True)
+    assert not any("state" in a for a in outputs.list_arguments())
+
+
+def test_fused_rnn_dropout_active_in_executor_training():
+    """Executor is_train=True injects training into RNN so inter-layer
+    dropout fires (review finding: it was silently off)."""
+    cell = mx.rnn.FusedRNNCell(8, num_layers=2, mode="rnn_tanh",
+                               dropout=0.9, prefix="dr_")
+    outputs, _ = cell.unroll(3, mx.sym.var("data"), merge_outputs=True)
+    shapes, _, _ = outputs.infer_shape(data=(2, 3, 4))
+    rs = np.random.RandomState(8)
+    feed = {n: mx.nd.array(rs.randn(*s).astype("f") * 0.5)
+            for n, s in zip(outputs.list_arguments(), shapes)}
+    ex = outputs.bind(mx.cpu(), feed)
+    y_train = ex.forward(is_train=True)[0].asnumpy()
+    y_infer = ex.forward(is_train=False)[0].asnumpy()
+    # dropout 0.9 between layers makes train output differ from inference
+    assert not np.allclose(y_train, y_infer, atol=1e-6)
