@@ -157,17 +157,20 @@ class LlamaDecoderLayer(HybridBlock):
 
                 out = jax.checkpoint(body_pure)(xv)
                 return NDArray._from_jax(out, getattr(x, "context", None))
-            if type(x).__name__ == "SymbolTracer":
-                # hybridize() stages through the Symbol graph, which has no
-                # remat node — warn rather than silently skipping the
-                # memory saving the user asked for
+            # eager tape (autograd.record) and hybridize() both lack a
+            # remat node — warn rather than silently skipping the memory
+            # saving the user asked for
+            from .... import autograd as _ag
+
+            if type(x).__name__ == "SymbolTracer" or _ag.is_recording():
                 import warnings
 
                 warnings.warn(
                     "LlamaConfig(remat=True) has no effect under "
-                    "hybridize(); use parallel.data_parallel.TrainStep "
-                    "(or jax.jit over the functionalized net) for "
-                    "rematerialized training", stacklevel=2)
+                    "hybridize() or the eager autograd tape; use "
+                    "parallel.data_parallel.TrainStep (or jax.jit over "
+                    "the functionalized net) for rematerialized training",
+                    stacklevel=2)
         return self._body(x)
 
 
