@@ -1,0 +1,149 @@
+"""Per-operator micro-benchmark harness.
+
+Reference: ``benchmark/opperf/`` (run_performance_test + the category
+runners — SURVEY.md §3.7 "Benchmark harnesses").  Times individual
+registry ops (forward, and backward where differentiable) with proper
+device synchronization; prints one JSON document.
+
+Usage::
+
+    python benchmark/opperf.py                 # representative op set
+    python benchmark/opperf.py --ops exp,dot   # chosen ops
+
+or programmatically::
+
+    from benchmark.opperf import run_performance_test
+    res = run_performance_test("dot", {"lhs": (256, 256),
+                                       "rhs": (256, 256)}, run_backward=True)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _mx():
+    import mxnet_tpu as mx
+
+    return mx
+
+
+def _make_inputs(shapes, ctx, seed=0):
+    mx = _mx()
+    rs = np.random.RandomState(seed)
+    args = []
+    for shp in shapes.values():
+        if isinstance(shp, tuple):
+            args.append(mx.nd.array(
+                rs.uniform(0.5, 1.5, shp).astype("float32"), ctx=ctx))
+        else:
+            args.append(shp)  # scalar attr passed positionally
+    return args
+
+
+def run_performance_test(op, inputs, attrs=None, run_backward=False,
+                         ctx=None, warmup=5, runs=20):
+    """Time one op.  inputs: {name: shape-tuple | scalar}.  Returns a dict
+    with avg forward (and backward) milliseconds."""
+    mx = _mx()
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray.ndarray import invoke
+
+    ctx = ctx or mx.current_context()
+    attrs = dict(attrs or {})
+    nd_args = _make_inputs(inputs, ctx)
+
+    def fwd():
+        out = invoke(op, [a for a in nd_args if hasattr(a, "asnumpy")],
+                     attrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        outs[0].asnumpy()  # sync point
+        return outs
+
+    for _ in range(warmup):
+        fwd()
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        fwd()
+    fwd_ms = (time.perf_counter() - t0) / runs * 1e3
+
+    result = {"operator": op, "inputs": {k: list(v) if isinstance(v, tuple)
+                                         else v for k, v in inputs.items()},
+              "avg_forward_time_ms": round(fwd_ms, 4)}
+    if run_backward:
+        arrs = [a for a in nd_args if hasattr(a, "asnumpy")]
+        for a in arrs:
+            a.attach_grad()
+
+        def both():
+            with autograd.record():
+                out = invoke(op, arrs, attrs)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                head = outs[0].sum()
+            head.backward()
+            arrs[0].grad.asnumpy()  # sync point
+
+        for _ in range(warmup):
+            both()
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            both()
+        result["avg_forward_backward_time_ms"] = round(
+            (time.perf_counter() - t0) / runs * 1e3, 4)
+    return result
+
+
+# representative categories (reference: opperf's default run covers the
+# unary/binary/reduction/GEMM/NN families)
+DEFAULT_SUITE = [
+    ("exp", {"data": (1024, 1024)}, {}, True),
+    ("sqrt", {"data": (1024, 1024)}, {}, True),
+    ("elemwise_add", {"lhs": (1024, 1024), "rhs": (1024, 1024)}, {}, True),
+    ("broadcast_mul", {"lhs": (1024, 1024), "rhs": (1, 1024)}, {}, True),
+    ("sum", {"data": (1024, 1024)}, {"axis": 1}, True),
+    ("dot", {"lhs": (512, 512), "rhs": (512, 512)}, {}, True),
+    ("batch_dot", {"lhs": (8, 256, 256), "rhs": (8, 256, 256)}, {}, True),
+    ("FullyConnected", {"data": (128, 512), "weight": (256, 512),
+                        "bias": (256,)}, {"num_hidden": 256}, True),
+    ("Convolution", {"data": (8, 32, 56, 56), "weight": (64, 32, 3, 3)},
+     {"kernel": (3, 3), "pad": (1, 1), "num_filter": 64, "no_bias": True},
+     True),
+    ("Pooling", {"data": (8, 32, 56, 56)},
+     {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"}, False),
+    ("softmax", {"data": (128, 1024)}, {}, True),
+    ("topk", {"data": (128, 1024)}, {"k": 8}, False),
+]
+
+
+def run_all(suite=None, ctx=None, warmup=5, runs=20):
+    out = []
+    for op, inputs, attrs, bwd in (suite or DEFAULT_SUITE):
+        try:
+            out.append(run_performance_test(op, inputs, attrs,
+                                            run_backward=bwd, ctx=ctx,
+                                            warmup=warmup, runs=runs))
+        except Exception as e:  # keep the sweep alive per-op
+            out.append({"operator": op, "error": repr(e)[:200]})
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset of the default suite")
+    ap.add_argument("--runs", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=5)
+    args = ap.parse_args()
+    suite = DEFAULT_SUITE
+    if args.ops:
+        want = set(args.ops.split(","))
+        suite = [row for row in DEFAULT_SUITE if row[0] in want]
+    res = run_all(suite, warmup=args.warmup, runs=args.runs)
+    print(json.dumps({"opperf": res}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

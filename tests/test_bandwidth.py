@@ -31,3 +31,20 @@ def test_cli_json_output(capsys):
 
     parsed = [json.loads(l) for l in out]
     assert parsed[0]["size_mb"] == 0.25
+
+
+def test_opperf_harness_runs():
+    """Per-op microbenchmark harness (reference: benchmark/opperf)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from benchmark.opperf import run_performance_test, run_all
+
+    r = run_performance_test("exp", {"data": (8, 8)}, run_backward=True,
+                             warmup=1, runs=2)
+    assert r["avg_forward_time_ms"] > 0
+    assert "avg_forward_backward_time_ms" in r
+    suite = [("elemwise_add", {"lhs": (4, 4), "rhs": (4, 4)}, {}, False),
+             ("no_such_op", {"data": (2,)}, {}, False)]
+    out = run_all(suite, warmup=1, runs=1)
+    assert out[0]["avg_forward_time_ms"] > 0
+    assert "error" in out[1]  # sweep survives unknown ops
