@@ -32,16 +32,18 @@ def _mx():
 
 
 def _make_inputs(shapes, ctx, seed=0):
+    """Split the inputs dict: shape tuples become random arrays, anything
+    else is a named attr (reference opperf mixes both in one dict)."""
     mx = _mx()
     rs = np.random.RandomState(seed)
-    args = []
-    for shp in shapes.values():
+    args, extra_attrs = [], {}
+    for name, shp in shapes.items():
         if isinstance(shp, tuple):
             args.append(mx.nd.array(
                 rs.uniform(0.5, 1.5, shp).astype("float32"), ctx=ctx))
         else:
-            args.append(shp)  # scalar attr passed positionally
-    return args
+            extra_attrs[name] = shp
+    return args, extra_attrs
 
 
 def run_performance_test(op, inputs, attrs=None, run_backward=False,
@@ -53,12 +55,11 @@ def run_performance_test(op, inputs, attrs=None, run_backward=False,
     from mxnet_tpu.ndarray.ndarray import invoke
 
     ctx = ctx or mx.current_context()
-    attrs = dict(attrs or {})
-    nd_args = _make_inputs(inputs, ctx)
+    nd_args, extra_attrs = _make_inputs(inputs, ctx)
+    attrs = {**extra_attrs, **(attrs or {})}
 
     def fwd():
-        out = invoke(op, [a for a in nd_args if hasattr(a, "asnumpy")],
-                     attrs)
+        out = invoke(op, nd_args, attrs)  # invoke coerces scalar inputs
         outs = out if isinstance(out, (list, tuple)) else [out]
         outs[0].asnumpy()  # sync point
         return outs
@@ -80,7 +81,7 @@ def run_performance_test(op, inputs, attrs=None, run_backward=False,
 
         def both():
             with autograd.record():
-                out = invoke(op, arrs, attrs)
+                out = invoke(op, nd_args, attrs)
                 outs = out if isinstance(out, (list, tuple)) else [out]
                 head = outs[0].sum()
             head.backward()
@@ -141,6 +142,11 @@ def main():
     if args.ops:
         want = set(args.ops.split(","))
         suite = [row for row in DEFAULT_SUITE if row[0] in want]
+        missing = want - {row[0] for row in suite}
+        if missing:
+            raise SystemExit(
+                f"--ops names not in the default suite: {sorted(missing)}; "
+                f"available: {sorted({r[0] for r in DEFAULT_SUITE})}")
     res = run_all(suite, warmup=args.warmup, runs=args.runs)
     print(json.dumps({"opperf": res}, indent=2))
 

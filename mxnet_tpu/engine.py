@@ -26,7 +26,7 @@ import contextlib
 import os
 
 __all__ = ["set_matmul_precision", "set_engine_type", "engine_type",
-           "naive_engine"]
+           "naive_engine", "set_nan_check", "nan_check_enabled"]
 
 _VALID_PRECISION = ("default", "high", "highest", "bfloat16",
                     "tensorfloat32", "float32")
@@ -67,6 +67,8 @@ def _init_from_env():
             set_matmul_precision("highest")
     if os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine":
         set_engine_type("NaiveEngine")
+    if os.environ.get("MXNET_NAN_CHECK", "") in ("1", "true", "True"):
+        set_nan_check(True)
 
 
 def engine_type():
@@ -102,3 +104,19 @@ def naive_engine():
         yield
     finally:
         set_engine_type(prev)
+
+
+def set_nan_check(enabled=True):
+    """Device-side NaN/Inf sanitizer on the imperative dispatch seam
+    (SURVEY.md §6.2: the TPU analog of the reference's sanitizer CI lane;
+    env: MXNET_NAN_CHECK=1).  Synchronizes per op while on — a debug mode,
+    like NaiveEngine."""
+    from .ndarray.ndarray import _NAN_CHECK
+
+    _NAN_CHECK["on"] = bool(enabled)
+
+
+def nan_check_enabled():
+    from .ndarray.ndarray import _NAN_CHECK
+
+    return _NAN_CHECK["on"]

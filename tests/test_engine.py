@@ -63,3 +63,21 @@ def test_waitall_propagates_errors():
     assert raised
     # session survives, other arrays still usable
     assert ok.asnumpy().sum() == 2
+
+
+def test_nan_check_sanitizer():
+    """engine.set_nan_check raises at the offending op, names it, and the
+    session survives (SURVEY §6.2 sanitizer analog)."""
+    import numpy as np
+
+    engine.set_nan_check(True)
+    try:
+        ok = nd.log(nd.array(np.array([1.0, 2.0], "f")))  # finite: fine
+        assert np.isfinite(ok.asnumpy()).all()
+        with pytest.raises(mx.MXNetError, match="log"):
+            nd.log(nd.array(np.array([-1.0], "f")))
+    finally:
+        engine.set_nan_check(False)
+    # off again: non-finite passes through silently (default behavior)
+    bad = nd.log(nd.array(np.array([-1.0], "f")))
+    assert np.isnan(bad.asnumpy()).all()
