@@ -1,0 +1,75 @@
+"""Image-classification training example (reference:
+example/image-classification/train_imagenet.py shape, runnable offline on
+synthetic data).
+
+CPU smoke:   python train_synthetic.py --epochs 1 --batch-size 8 --size 32
+TPU:         python train_synthetic.py --layout NHWC --dtype bfloat16
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet18_v1")
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--steps-per-epoch", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--layout", default="NCHW", choices=["NCHW", "NHWC"])
+    ap.add_argument("--dtype", default=None, choices=[None, "bfloat16",
+                                                      "float16"])
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    net = vision.get_model(args.network, classes=args.classes,
+                           layout=args.layout)
+    net.initialize(mx.init.Xavier(), ctx=mx.current_context())
+    shape = ((1, args.size, args.size, 3) if args.layout == "NHWC"
+             else (1, 3, args.size, args.size))
+    net(mx.nd.zeros(shape))
+
+    def loss_fn(logits, labels):
+        import jax
+        import jax.numpy as jnp
+
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=-1)
+
+    step = TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": args.lr,
+                                       "momentum": 0.9},
+                     train_mode=True, dtype=args.dtype)
+    rs = np.random.RandomState(0)
+    bshape = (args.batch_size,) + shape[1:]
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        loss = None
+        for _ in range(args.steps_per_epoch):
+            x = rs.uniform(-1, 1, bshape).astype("float32")
+            y = rs.randint(0, args.classes,
+                           (args.batch_size,)).astype("int32")
+            loss = step(x, y)
+        lv = float(np.asarray(loss))
+        dt = time.time() - t0
+        ips = args.batch_size * args.steps_per_epoch / dt
+        print(f"epoch {epoch}: loss {lv:.4f}  {ips:.1f} img/s")
+    step.write_back()
+    net.export("model", 0, mx.nd.zeros(shape))
+    print("exported model-symbol.json / model-0000.params")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Bucketed RNN language model (reference: example/rnn/bucketing/
+lstm_bucketing.py — BucketSentenceIter + FusedRNNCell + BucketingModule)."""
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-layers", type=int, default=1)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+
+    rs = np.random.RandomState(0)
+    sents = [list(rs.randint(1, args.vocab, rs.randint(4, 17)))
+             for _ in range(256)]
+    it = mx.rnn.BucketSentenceIter(sents, args.batch_size,
+                                   buckets=[8, 16], invalid_label=0)
+
+    def sym_gen(seq_len):
+        cell = mx.rnn.FusedRNNCell(args.num_hidden,
+                                   num_layers=args.num_layers,
+                                   mode="lstm", prefix="lstm_")
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        emb = mx.sym.Embedding(data, input_dim=args.vocab, output_dim=32,
+                               name="embed")
+        outputs, _ = cell.unroll(seq_len, emb, merge_outputs=True)
+        pred = mx.sym.FullyConnected(
+            mx.sym.reshape(outputs, shape=(-1, args.num_hidden)),
+            num_hidden=args.vocab, name="pred")
+        label = mx.sym.reshape(label, shape=(-1,))
+        return mx.sym.SoftmaxOutput(pred, label, name="softmax"), \
+            ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params=(("learning_rate", 3e-3),))
+    metric = mx.metric.Perplexity(ignore_label=0)
+    for epoch in range(args.epochs):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        print(f"epoch {epoch}: {metric.get()[0]} {metric.get()[1]:.2f}")
+
+
+if __name__ == "__main__":
+    main()
