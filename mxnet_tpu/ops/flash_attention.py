@@ -135,7 +135,21 @@ def pl_load(ref, block_idx, block_size):
     return ref[pl.ds(block_idx * block_size, block_size), :]
 
 
-def _fa_forward_pallas(q, k, v, causal, sm_scale, block_q=128, block_k=128):
+def _fa_block_sizes():
+    """Forward kernel tile sizes, overridable for on-chip tuning sweeps
+    (MXNET_FLASH_BLOCK_Q / MXNET_FLASH_BLOCK_KV; defaults 128 = one MXU
+    lane tile).  Values must divide the padded sequence length."""
+    import os
+
+    return (int(os.environ.get("MXNET_FLASH_BLOCK_Q", 128)),
+            int(os.environ.get("MXNET_FLASH_BLOCK_KV", 128)))
+
+
+def _fa_forward_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None):
+    if block_q is None or block_k is None:
+        bq, bk = _fa_block_sizes()
+        block_q = bq if block_q is None else block_q
+        block_k = bk if block_k is None else block_k
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
