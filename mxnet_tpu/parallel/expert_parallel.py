@@ -1,0 +1,86 @@
+"""Expert parallelism: a switch-style MoE layer over the ``ep`` mesh axis.
+
+Capability upgrade over the reference (MXNet 1.x has no MoE).  TPU-native
+formulation (Mesh-TF/Switch-Transformer style): routing is expressed as
+dense one-hot dispatch/combine einsums — compiler-friendly static shapes —
+with the expert dimension sharded over ``ep``; GSPMD turns the
+token→expert regrouping einsums into all_to_all collectives riding ICI.
+
+Top-1 (switch) routing with capacity dropping: tokens beyond an expert's
+capacity pass through the residual (combine weight 0), the standard
+overflow behavior.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["moe_apply", "stack_expert_params"]
+
+
+def stack_expert_params(per_expert):
+    """[expert0_tree, ...] -> tree with leading expert axis (sharded
+    over ep by moe_apply)."""
+    from .pipeline_parallel import stack_stage_params
+
+    return stack_stage_params(per_expert)
+
+
+def moe_apply(expert_fn, expert_params, router_weight, x, mesh=None,
+              axis="ep", capacity_factor=1.25):
+    """Top-1 MoE layer.
+
+    expert_fn(params_one_expert, tokens (C, d)) -> (C, d)
+    expert_params: pytree, leaves (E, ...); router_weight (d, E);
+    x (T, d).  Returns (out (T, d), aux) where aux has the load-balancing
+    loss (Switch-Transformer eq. 4) and per-expert load.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    T, d = x.shape
+    E = router_weight.shape[1]
+    if mesh is not None and E % mesh.shape[axis]:
+        raise MXNetError(f"num experts {E} not divisible by ep axis "
+                         f"{mesh.shape[axis]}")
+    C = max(1, int(capacity_factor * T / E))
+
+    logits = x @ router_weight                       # (T, E)
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)          # (T,)
+    gate = jnp.take_along_axis(gates, expert_idx[:, None], axis=1)[:, 0]
+    sel = jax.nn.one_hot(expert_idx, E, dtype=x.dtype)   # (T, E)
+
+    # position of each token within its expert's queue; >= C drops
+    pos = jnp.cumsum(sel, axis=0) * sel - 1.0            # (T, E)
+    keep = (pos >= 0) & (pos < C)
+    dispatch = sel[:, :, None] * jax.nn.one_hot(
+        jnp.clip(pos, 0, C - 1).astype(jnp.int32), C,
+        dtype=x.dtype)                                   # (T, E, C)
+    dispatch = dispatch * keep.astype(x.dtype)[:, :, None]
+    combine = dispatch * gate[:, None, None]
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)   # (E, C, d)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P(axis, None, None)))
+        expert_params = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, NamedSharding(
+                mesh, P(axis, *([None] * (leaf.ndim - 1))))),
+            expert_params)
+    expert_out = jax.vmap(expert_fn)(expert_params, expert_in)  # (E, C, d)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        expert_out = jax.lax.with_sharding_constraint(
+            expert_out, NamedSharding(mesh, P(axis, None, None)))
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+
+    # Switch load-balance loss: E * sum_e f_e * p_e
+    f = sel.mean(axis=0)                                  # fraction routed
+    p = gates.mean(axis=0)                                # mean router prob
+    aux = {"load_balance_loss": E * jnp.sum(f * p),
+           "expert_load": sel.sum(axis=0),
+           "dropped": T - jnp.sum(dispatch)}
+    return out, aux

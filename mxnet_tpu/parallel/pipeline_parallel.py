@@ -1,0 +1,119 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+Capability upgrade over the reference (MXNet 1.x has no pipeline
+parallelism; its answer to big models was parameter servers).  TPU-native
+shape, per the scaling-book recipe: each pp device holds ONE stage's
+parameters (weight-stationary); microbatches stream through the pipeline
+with ``lax.ppermute`` passing activations over ICI between ticks.  With M
+microbatches and S stages the loop runs M+S-1 ticks and every device is
+busy in the steady state (bubble fraction (S-1)/(M+S-1)).
+
+The whole schedule is one jit-able, differentiable function —
+``jax.grad`` through it gives 1F1B-equivalent memory behavior when
+combined with per-stage ``jax.checkpoint``.
+
+Usage::
+
+    S = mesh.shape["pp"]
+    # stage_params: pytree whose leaves have leading axis S (stage-major)
+    out = pipeline_apply(stage_fn, stage_params, x, mesh,
+                         num_microbatches=M)
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage axis
+    (what pipeline_apply shards over pp)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                  *per_stage_params)
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
+                   axis="pp", remat_stage=False):
+    """Run ``stage_fn`` as an S-stage pipeline over the mesh's pp axis.
+
+    stage_fn(params_one_stage, x_mb) -> y_mb, where y_mb has x_mb's shape
+    (homogeneous stages — the transformer-stack case).
+    stage_params: pytree, leaves shaped (S, ...); sharded over pp here.
+    x: global batch, leading dim divisible by num_microbatches.
+    Returns stage_{S-1}(...stage_0(x)) with the same sharding as x.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    S = mesh.shape[axis]
+    M = int(num_microbatches)
+    if x.shape[0] % M:
+        raise MXNetError(f"batch {x.shape[0]} not divisible by "
+                         f"num_microbatches {M}")
+    n_stages = {leaf.shape[0]
+                for leaf in jax.tree_util.tree_leaves(stage_params)}
+    if n_stages != {S}:
+        raise MXNetError(
+            f"stage_params leading dim {sorted(n_stages)} must equal the "
+            f"pp axis size {S} (one stage per device)")
+
+    def leaf_spec(leaf):
+        return P(axis, *([None] * (leaf.ndim - 1)))
+
+    pspecs = jax.tree_util.tree_map(leaf_spec, stage_params)
+    stage_params = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        stage_params, pspecs)
+
+    if remat_stage:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    def pp_fn(params_local, xs):
+        # params_local: leaves (1, ...) — this device's stage
+        # xs: (M, mb, ...) microbatched input (replicated over pp)
+        s = jax.lax.axis_index(axis)
+        p_one = jax.tree_util.tree_map(lambda l: l[0], params_local)
+        mb_shape = xs.shape[1:]
+        state = jnp.zeros(mb_shape, xs.dtype)     # activation in flight
+        outputs = jnp.zeros_like(xs)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (garbage after t >= M is
+            # masked out on the output side)
+            mb_in = xs[jnp.minimum(t, M - 1)]
+            inp = jnp.where(s == 0, mb_in, state)
+            out = stage_fn(p_one, inp)
+            # last stage completed microbatch t-(S-1) at this tick
+            done_idx = t - (S - 1)
+            write = (s == S - 1) & (done_idx >= 0)
+            di = jnp.maximum(done_idx, 0)
+            # jnp.where (not arithmetic masking): warmup-tick garbage can
+            # be NaN and NaN*0 would poison valid outputs
+            outputs = outputs.at[di].set(
+                jnp.where(write, out, outputs[di]))
+            # pass activations downstream (stage S-1 -> 0 link carries
+            # garbage; stage 0 ignores its input)
+            state = jax.lax.ppermute(out, axis, perm)
+            return (state, outputs), None
+
+        # scan (not fori_loop): the schedule must be reverse-differentiable
+        (_, outputs), _ = jax.lax.scan(tick, (state, outputs),
+                                       jnp.arange(M + S - 1))
+        # result lives on the last stage; broadcast over pp
+        outputs = jnp.where(s == S - 1, outputs, jnp.zeros_like(outputs))
+        return jax.lax.psum(outputs, axis)
+
+    xs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+    in_specs = (pspecs, P(*([None] * xs.ndim)))
+    out_spec = P(*([None] * xs.ndim))
+    y = shard_map(pp_fn, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_spec, check_rep=False)(stage_params, xs)
+    return y.reshape(x.shape)
