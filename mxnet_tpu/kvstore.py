@@ -145,10 +145,15 @@ class KVStore:
 
     def set_gradient_compression(self, compression_params):
         ctype = compression_params.get("type", "2bit")
-        if ctype != "2bit":
+        if ctype == "2bit":
+            self._compression = TwoBitCompression(
+                float(compression_params.get("threshold", 0.5)))
+        elif ctype == "int8":
+            # TPU-native EQuARX-style: quantization happens inside the
+            # collective in the dist store; locally it is a round-trip
+            self._compression = Int8Compression()
+        else:
             raise MXNetError(f"unknown gradient compression type {ctype}")
-        self._compression = TwoBitCompression(
-            float(compression_params.get("threshold", 0.5)))
 
     # -- optimizer state io --------------------------------------------------
     def save_optimizer_states(self, fname, dump_optimizer=False):
@@ -193,6 +198,22 @@ class TwoBitCompression:
         q = jnp.where(g >= t, t, jnp.where(g <= -t, -t, 0.0)).astype(g.dtype)
         self._residuals[key] = g - q
         return NDArray._from_jax(q, grad_nd.context)
+
+
+class Int8Compression:
+    """int8 symmetric quantization (PAPERS.md EQuARX).  In dist_tpu_sync
+    the payload is quantized INSIDE the allreduce (allreduce_hosts_
+    quantized: 4x less network traffic); in local stores this round-trip
+    models the same error."""
+
+    def round_trip(self, grad_nd, key=None):
+        from .parallel.collectives import _int8_quantize
+
+        import jax.numpy as jnp
+
+        q, scale = _int8_quantize(grad_nd._get())
+        return NDArray._from_jax(q.astype(jnp.float32) * scale,
+                                 grad_nd.context)
 
 
 class DistTPUSyncKVStore(KVStore):
@@ -250,6 +271,15 @@ class DistTPUSyncKVStore(KVStore):
                 getattr(self, "_sharded_update", False)
                 and self._updater is not None):
             reduced_list = self._allreduce_bucketed(reduced_list)
+        # int8 compression happens INSIDE the bucketed collective; a host
+        # round-trip afterwards would quantize the already-summed gradient
+        # a second time
+        already_compressed = (self.num_workers > 1
+                              and isinstance(self._compression,
+                                             Int8Compression)
+                              and not (getattr(self, "_sharded_update",
+                                               False)
+                                       and self._updater is not None))
         for k, reduced in zip(keys, reduced_list):
             if getattr(self, "_sharded_update", False) and \
                     self._updater is not None:
@@ -260,7 +290,7 @@ class DistTPUSyncKVStore(KVStore):
                     reduced = self._compression.round_trip(reduced, key=k)
                 self._updater(_key_int(k), reduced, self._store[k])
                 continue
-            if self._compression is not None:
+            if self._compression is not None and not already_compressed:
                 reduced = self._compression.round_trip(reduced, key=k)
             if self._updater is not None:
                 self._updater(_key_int(k), reduced, self._store[k])
@@ -281,13 +311,20 @@ class DistTPUSyncKVStore(KVStore):
         from .parallel.collectives import allreduce_hosts
 
         bound = env.kvstore_bigarray_bound()
+        reduce_fn = allreduce_hosts
+        if isinstance(self._compression, Int8Compression):
+            # quantize inside the collective (same bucketing: fused small
+            # tensors share one int8 payload + scale)
+            from .parallel.collectives import allreduce_hosts_quantized
+
+            reduce_fn = allreduce_hosts_quantized
         vals = [nd._get() for nd in nds]
         small = [i for i, v in enumerate(vals)
                  if v.size <= bound and v.dtype == vals[0].dtype]
         out = list(vals)
         if len(small) > 1:
             flat = jnp.concatenate([vals[i].ravel() for i in small])
-            summed = allreduce_hosts(flat)
+            summed = reduce_fn(flat)
             off = 0
             for i in small:
                 n = vals[i].size
@@ -297,7 +334,7 @@ class DistTPUSyncKVStore(KVStore):
             small = []
         for i in range(len(vals)):
             if i not in small:
-                out[i] = allreduce_hosts(vals[i])
+                out[i] = reduce_fn(vals[i])
         return [NDArray._from_jax(v, nd.context)
                 for v, nd in zip(out, nds)]
 

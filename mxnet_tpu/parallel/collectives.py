@@ -11,7 +11,8 @@ from __future__ import annotations
 from functools import partial
 
 __all__ = ["psum", "pmean", "all_gather", "reduce_scatter", "ppermute",
-           "all_to_all", "allreduce_hosts", "barrier"]
+           "all_to_all", "allreduce_hosts", "allreduce_hosts_quantized",
+           "barrier"]
 
 
 def psum(x, axis_name="dp"):
@@ -51,29 +52,46 @@ def all_to_all(x, axis_name="sp", split_axis=0, concat_axis=0, tiled=True):
     return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=tiled)
 
 
+def _cross_process_combine(local_leaves, combine_fn):
+    """Shared scaffold for host-value collectives: ship each leaf as a
+    global array sharded over all devices ('w' axis, one contribution per
+    process replicated across its local devices), then jit combine_fn over
+    the stacked leaves.  combine_fn sees leaves with a leading axis of
+    n_processes*n_local and must normalize by n_local itself via the
+    provided count (it receives (leaves..., n_local))."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(jax.devices(), ("w",))
+    n_local = jax.local_device_count()
+
+    def rep(a):
+        a = jnp.asarray(a)
+        return jnp.broadcast_to(a[None], (n_local,) + a.shape)
+
+    globals_ = [jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("w")), rep(leaf)) for leaf in local_leaves]
+
+    @partial(jax.jit, static_argnums=(len(globals_),),
+             out_shardings=NamedSharding(mesh, P()))
+    def _combine(*args):
+        leaves, nl = args[:-1], args[-1]
+        return combine_fn(*leaves, nl)
+
+    return _combine(*globals_, n_local)
+
+
 def allreduce_hosts(value):
     """Allreduce a host-local array across all processes' devices: builds a
     global array sharded over processes and psums it.  Used by the
     dist_tpu_sync KVStore (single psum ≙ push+pull, SURVEY.md §4.4)."""
     import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     if jax.process_count() == 1:
         return value
-    mesh = Mesh(jax.devices(), ("w",))
-    # each process contributes its local value on its own device shard;
-    # stack over a leading axis, psum via sum-reduction of the global array
-    g = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P("w")),
-        value[None].repeat(jax.local_device_count(), axis=0)
-        if hasattr(value, "repeat") else jnp.broadcast_to(value[None], (jax.local_device_count(),) + value.shape))
-
-    @partial(jax.jit, out_shardings=NamedSharding(mesh, P()))
-    def _sum(a):
-        return a.sum(axis=0) / jax.local_device_count()
-
-    return _sum(g)
+    return _cross_process_combine(
+        (value,), lambda a, nl: a.sum(axis=0) / nl)
 
 
 def barrier():
@@ -83,3 +101,39 @@ def barrier():
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("mxnet_tpu_barrier")
+
+
+def _int8_quantize(v):
+    """Per-tensor symmetric int8 quantization (scale, payload)."""
+    import jax.numpy as jnp
+
+    scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-30) / 127.0
+    q = jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def allreduce_hosts_quantized(value, _testing_force=False):
+    """Bandwidth-compressed cross-process allreduce: each process ships an
+    int8 payload + fp32 scale instead of fp32 (~4x less DCN/ICI traffic),
+    dequantize-sum on receipt.
+
+    Inspired by EQuARX (PAPERS.md: "Efficient Quantized AllReduce in XLA")
+    — the XLA-native take on the reference's 2-bit kvstore compression,
+    applied inside the collective rather than before it.  Max error per
+    contribution is scale/2 = max|v|/254.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if jax.process_count() == 1 and not _testing_force:
+        return value
+    q, scale = _int8_quantize(value)
+
+    def combine(qa, sa, nl):
+        # dequantize each contribution with its own scale, then sum;
+        # the int8 payload is what crossed the network
+        deq = qa.astype(jnp.float32) * sa.reshape(
+            (-1,) + (1,) * (qa.ndim - 1))
+        return deq.sum(axis=0) / nl
+
+    return _cross_process_combine((q, scale), combine)

@@ -90,6 +90,13 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
             # masked out on the output side)
             mb_in = xs[jnp.minimum(t, M - 1)]
             inp = jnp.where(s == 0, mb_in, state)
+            # double-where: on bubble ticks (device s busy only for
+            # s <= t < s+M) substitute a finite placeholder, so stage_fn
+            # never evaluates on garbage — otherwise a NaN-capable stage
+            # poisons the BACKWARD pass (0 cotangent x NaN Jacobian = NaN)
+            # even though the forward masks discard the value
+            valid = (t >= s) & (t < s + M)
+            inp = jnp.where(valid, inp, xs[0])
             out = stage_fn(p_one, inp)
             # last stage completed microbatch t-(S-1) at this tick
             done_idx = t - (S - 1)

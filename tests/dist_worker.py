@@ -29,6 +29,18 @@ kv.pull(3, out)
 expect = float(sum(r + 1 for r in range(n)))
 np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
 
+# 1b. int8 quantized allreduce across processes (EQuARX-style)
+kv.set_gradient_compression({"type": "int8"})
+kv.init(9, mx.nd.zeros((5,)))
+local_g = np.array([0.5, -1.0, 0.25, 0.0, 2.0], "f") * (rank + 1)
+kv.push(9, mx.nd.array(local_g))
+out9 = mx.nd.zeros((5,))
+kv.pull(9, out9)
+expect9 = np.array([0.5, -1.0, 0.25, 0.0, 2.0], "f") * 3  # ranks 1+2
+np.testing.assert_allclose(out9.asnumpy(), expect9,
+                           atol=2 * np.abs(expect9).max() / 127 + 1e-5)
+kv._compression = None  # back to exact for later sections
+
 # 2. update_on_kvstore: sharded optimizer (reduce-scatter + all-gather)
 kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.0))
 w0 = np.arange(12, dtype="f").reshape(3, 4) / 10.0

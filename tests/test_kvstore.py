@@ -131,3 +131,35 @@ def test_gradient_compression_training_converges():
     # the uncompressed trajectory step for step)
     assert losses[-1] < 0.2 * losses[0], (losses[0], losses[-1])
     assert all(np.isfinite(l) for l in losses)
+
+
+def test_int8_gradient_compression_local():
+    """int8 compression (EQuARX-style): values round-trip within
+    max|v|/254 per element."""
+    kv = kvstore.create('local')
+    kv.init('g8', nd.zeros((6,)))
+    kv.set_gradient_compression({'type': 'int8'})
+    v = np.array([1.0, -0.5, 0.25, 0.0, 0.77, -1.0], 'f')
+    kv.push('g8', [nd.array(v)])
+    out = nd.zeros((6,))
+    kv.pull('g8', out=out)
+    assert np.allclose(out.asnumpy(), v, atol=1.0 / 254 + 1e-6)
+
+
+def test_quantized_allreduce_math():
+    """allreduce_hosts_quantized: int8 payload + per-contribution scale
+    reconstructs the sum within quantization error (single-process path
+    exercised via _testing_force on the virtual mesh)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel.collectives import (allreduce_hosts_quantized,
+                                                _int8_quantize)
+
+    v = np.array([0.9, -0.33, 0.0001, -1.7], 'f')
+    out = np.asarray(allreduce_hosts_quantized(jnp.asarray(v),
+                                               _testing_force=True))
+    assert np.allclose(out, v, atol=np.abs(v).max() / 127 + 1e-6)
+    q, s = _int8_quantize(jnp.asarray(v))
+    assert q.dtype == jnp.int8
+    assert np.allclose(np.asarray(q, 'f') * float(s), v,
+                       atol=np.abs(v).max() / 254 + 1e-6)

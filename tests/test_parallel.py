@@ -351,3 +351,40 @@ def test_pipeline_nan_safe_stage():
         ref = stage_fn({"w": stacked["w"][i]}, ref)
     assert np.isfinite(np.asarray(y)).all()
     assert float(jnp.abs(y - ref).max()) < 1e-4
+
+
+def test_pipeline_nan_safe_backward():
+    """Gradients stay finite (and correct) when the stage would NaN on the
+    bubble-tick garbage — the 0*NaN VJP gotcha (review finding)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.parallel.pipeline_parallel import (pipeline_apply,
+                                                      stack_stage_params)
+
+    S = 2
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+
+    def stage_fn(p, h):  # NaN on all-zero input
+        return (h / jnp.linalg.norm(h, axis=-1, keepdims=True)) @ p["w"]
+
+    rs = np.random.RandomState(2)
+    per = [{"w": jnp.asarray(rs.randn(4, 4).astype("f"))} for _ in range(S)]
+    stacked = stack_stage_params(per)
+    x = jnp.asarray(rs.randn(8, 4).astype("f"))
+
+    def loss_pp(p):
+        return pipeline_apply(stage_fn, p, x, mesh, 4).sum()
+
+    def loss_seq(per_):
+        h = x
+        for p in per_:
+            h = stage_fn(p, h)
+        return h.sum()
+
+    g_pp = jax.grad(loss_pp)(stacked)
+    assert np.isfinite(np.asarray(g_pp["w"])).all()
+    g_seq = stack_stage_params(jax.grad(loss_seq)(per))
+    assert float(jnp.abs(g_pp["w"] - g_seq["w"]).max()) < 1e-4
