@@ -26,7 +26,17 @@ class LlamaConfig:
     def __init__(self, vocab_size=128256, hidden_size=4096, num_layers=32,
                  num_heads=32, num_kv_heads=8, intermediate_size=14336,
                  rope_base=500000.0, max_seq_len=8192, rms_eps=1e-5,
-                 dtype="float32", tie_embeddings=False, remat=False):
+                 dtype="float32", tie_embeddings=False, remat=False,
+                 num_experts=0, moe_capacity_factor=1.25,
+                 moe_aux_loss_weight=0.01):
+        # num_experts > 0: Mixtral-style MoE FFN (switch top-1 routing,
+        # parallel.expert_parallel) replaces the dense SwiGLU MLP; shard
+        # the expert dim over the 'ep' mesh axis in TrainStep specs
+        self.num_experts = num_experts
+        self.moe_capacity_factor = moe_capacity_factor
+        # Switch load-balance loss coefficient, injected into the backward
+        # via parallel.expert_parallel.inject_aux_loss (0 disables)
+        self.moe_aux_loss_weight = moe_aux_loss_weight
         # remat: rematerialize each decoder layer's activations in backward
         # (jax.checkpoint) — trades ~1/3 more FLOPs for O(num_layers) less
         # activation HBM, the standard lever for bigger per-chip batches
@@ -121,6 +131,59 @@ class LlamaMLP(HybridBlock):
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
 
 
+class LlamaMoEMLP(HybridBlock):
+    """Switch-MoE SwiGLU FFN (Mixtral-style; net-new vs the reference).
+
+    Expert weights are stacked with a leading expert axis so
+    parallel.expert_parallel's dispatch/combine einsums (and the ep
+    sharding) apply directly."""
+
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        self._cfg = cfg
+        E, H, I = cfg.num_experts, cfg.hidden_size, cfg.intermediate_size
+        with self.name_scope():
+            self.router = self.params.get("router_weight", shape=(H, E))
+            self.gate_proj = self.params.get("gate_proj_weight",
+                                             shape=(E, H, I))
+            self.up_proj = self.params.get("up_proj_weight", shape=(E, H, I))
+            self.down_proj = self.params.get("down_proj_weight",
+                                             shape=(E, I, H))
+
+    def hybrid_forward(self, F, x, router, gate_proj, up_proj, down_proj):
+        from ....ndarray.ndarray import apply_fn
+        from ....parallel.expert_parallel import moe_apply
+
+        cfg = self._cfg
+
+        def expert_fn(p, toks):
+            import jax
+
+            g = toks @ p["g"]
+            u = toks @ p["u"]
+            return (jax.nn.silu(g) * u) @ p["d"]
+
+        def pure(xv, rv, gv, uv, dv):
+            from ....parallel.expert_parallel import inject_aux_loss
+
+            b, l, h = xv.shape
+            toks = xv.reshape(-1, h)
+            out, aux = moe_apply(
+                expert_fn, {"g": gv, "u": uv, "d": dv}, rv, toks,
+                capacity_factor=cfg.moe_capacity_factor)
+            out = out.reshape(b, l, h)
+            if cfg.moe_aux_loss_weight:
+                # router balance term rides the backward pass (Switch
+                # eq. 4); without it routing collapses onto few experts
+                out = inject_aux_loss(
+                    out, cfg.moe_aux_loss_weight
+                    * aux["load_balance_loss"].astype(out.dtype))
+            return out
+
+        return apply_fn(pure, [x, router, gate_proj, up_proj, down_proj],
+                        name="llama_moe_mlp")
+
+
 class LlamaDecoderLayer(HybridBlock):
     def __init__(self, cfg, **kwargs):
         super().__init__(**kwargs)
@@ -132,7 +195,10 @@ class LlamaDecoderLayer(HybridBlock):
             self.post_attention_layernorm = RMSNorm(
                 cfg.hidden_size, cfg.rms_eps,
                 prefix="post_attention_layernorm_")
-            self.mlp = LlamaMLP(cfg, prefix="mlp_")
+            if cfg.num_experts > 0:
+                self.mlp = LlamaMoEMLP(cfg, prefix="mlp_")
+            else:
+                self.mlp = LlamaMLP(cfg, prefix="mlp_")
 
     def _body(self, x):
         x = x + self.self_attn(self.input_layernorm(x))

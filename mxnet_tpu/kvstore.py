@@ -211,9 +211,11 @@ class Int8Compression:
 
         import jax.numpy as jnp
 
-        q, scale = _int8_quantize(grad_nd._get())
-        return NDArray._from_jax(q.astype(jnp.float32) * scale,
-                                 grad_nd.context)
+        g = grad_nd._get()
+        q, scale = _int8_quantize(g)
+        return NDArray._from_jax(
+            (q.astype(jnp.float32) * scale).astype(g.dtype),
+            grad_nd.context)
 
 
 class DistTPUSyncKVStore(KVStore):
@@ -311,10 +313,11 @@ class DistTPUSyncKVStore(KVStore):
         from .parallel.collectives import allreduce_hosts
 
         bound = env.kvstore_bigarray_bound()
+        int8 = isinstance(self._compression, Int8Compression)
         reduce_fn = allreduce_hosts
-        if isinstance(self._compression, Int8Compression):
-            # quantize inside the collective (same bucketing: fused small
-            # tensors share one int8 payload + scale)
+        if int8:
+            # quantize inside the collective; the fused bucket keeps a
+            # PER-TENSOR scale so small-magnitude grads keep resolution
             from .parallel.collectives import allreduce_hosts_quantized
 
             reduce_fn = allreduce_hosts_quantized
@@ -323,13 +326,22 @@ class DistTPUSyncKVStore(KVStore):
                  if v.size <= bound and v.dtype == vals[0].dtype]
         out = list(vals)
         if len(small) > 1:
-            flat = jnp.concatenate([vals[i].ravel() for i in small])
-            summed = reduce_fn(flat)
-            off = 0
-            for i in small:
-                n = vals[i].size
-                out[i] = summed[off:off + n].reshape(vals[i].shape)
-                off += n
+            if int8:
+                from .parallel.collectives import (
+                    allreduce_hosts_quantized_multi)
+
+                fused = allreduce_hosts_quantized_multi(
+                    [vals[i] for i in small])
+                for i, v in zip(small, fused):
+                    out[i] = v
+            else:
+                flat = jnp.concatenate([vals[i].ravel() for i in small])
+                summed = reduce_fn(flat)
+                off = 0
+                for i in small:
+                    n = vals[i].size
+                    out[i] = summed[off:off + n].reshape(vals[i].shape)
+                    off += n
         else:
             small = []
         for i in range(len(vals)):

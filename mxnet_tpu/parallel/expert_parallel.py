@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from ..base import MXNetError
 
-__all__ = ["moe_apply", "stack_expert_params"]
+__all__ = ["moe_apply", "stack_expert_params", "inject_aux_loss"]
 
 
 def stack_expert_params(per_expert):
@@ -84,3 +84,40 @@ def moe_apply(expert_fn, expert_params, router_weight, x, mesh=None,
            "expert_load": sel.sum(axis=0),
            "dropped": T - jnp.sum(dispatch)}
     return out, aux
+
+
+def _make_inject():
+    import jax
+
+    @jax.custom_vjp
+    def inject(x, aux_scalar):
+        return x
+
+    def fwd(x, aux_scalar):
+        return x, None
+
+    def bwd(_, g):
+        import jax.numpy as jnp
+
+        # the aux scalar receives cotangent 1 regardless of the
+        # downstream reduction: it behaves exactly as if added to the
+        # final scalar loss with coefficient 1 (the fairscale/DeepSeek
+        # AddAuxiliaryLoss pattern)
+        return g, jnp.ones((), g.dtype)
+
+    inject.defvjp(fwd, bwd)
+    return inject
+
+
+_INJECT = None
+
+
+def inject_aux_loss(x, aux_scalar):
+    """Forward identity on ``x``; in backward, ``aux_scalar`` contributes
+    its gradient as if summed into the final loss.  Lets a block deep in a
+    network (e.g. an MoE router's load-balance term) add a loss term
+    without threading it to the training loop."""
+    global _INJECT
+    if _INJECT is None:
+        _INJECT = _make_inject()
+    return _INJECT(x, aux_scalar)

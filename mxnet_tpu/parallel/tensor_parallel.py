@@ -25,8 +25,8 @@ from collections import OrderedDict
 
 from ..base import MXNetError
 
-__all__ = ["specs_from_rules", "megatron_specs", "MEGATRON_RULES",
-           "validate_specs"]
+__all__ = ["specs_from_rules", "megatron_specs", "moe_expert_specs",
+           "MEGATRON_RULES", "validate_specs"]
 
 
 def _P():
@@ -71,7 +71,9 @@ def specs_from_rules(params, rules, mesh, axis="tp", default=None):
                     spec = P(*tpl_axes)
                 else:
                     sdim = tpl.index("tp")
-                    if len(v.shape) >= len(tpl) and v.shape[sdim] % n == 0:
+                    # exact-rank match: a 3-D stacked-expert weight must
+                    # not be captured by the 2-D dense rule
+                    if len(v.shape) == len(tpl) and v.shape[sdim] % n == 0:
                         spec = P(*tpl_axes)
                 break
         specs[name] = spec
@@ -85,6 +87,25 @@ def megatron_specs(params, mesh, axis="tp"):
     if axis not in mesh.shape:
         raise MXNetError(f"mesh has no {axis!r} axis: {dict(mesh.shape)}")
     return specs_from_rules(params, MEGATRON_RULES, mesh, axis=axis)
+
+
+def moe_expert_specs(params, mesh, axis="ep"):
+    """Expert-parallel specs for stacked-expert MoE weights (leading
+    expert axis, e.g. model_zoo.language LlamaMoEMLP's (E, H, I) tensors):
+    shard the expert dim over ``axis``, replicate routers.  Merge on top
+    of megatron_specs for combined tp+ep meshes."""
+    if axis not in mesh.shape:
+        raise MXNetError(f"mesh has no {axis!r} axis: {dict(mesh.shape)}")
+    P = _P()
+    n = mesh.shape[axis]
+    specs = OrderedDict()
+    for name, v in params.items():
+        if re.search(r"(gate_proj|up_proj|down_proj)_weight$", name) \
+                and len(v.shape) == 3 and v.shape[0] % n == 0:
+            specs[name] = P(axis, None, None)
+        elif re.search(r"router_weight$", name):
+            specs[name] = P()
+    return specs
 
 
 def validate_specs(params, specs, mesh):

@@ -163,3 +163,31 @@ def test_quantized_allreduce_math():
     assert q.dtype == jnp.int8
     assert np.allclose(np.asarray(q, 'f') * float(s), v,
                        atol=np.abs(v).max() / 254 + 1e-6)
+
+
+def test_quantized_allreduce_multi_per_tensor_scales():
+    """Fused int8 bucket keeps per-tensor scales: a tiny gradient next to
+    a huge one still round-trips (review finding: a shared scale floors
+    it to zero)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel.collectives import (
+        allreduce_hosts_quantized_multi)
+
+    big = np.full((8,), 100.0, "f")
+    tiny = np.full((4,), 1e-4, "f")
+    out = allreduce_hosts_quantized_multi(
+        [jnp.asarray(big), jnp.asarray(tiny)], _testing_force=True)
+    assert np.allclose(np.asarray(out[0]), big, rtol=0.01)
+    assert np.allclose(np.asarray(out[1]), tiny, rtol=0.01)
+    assert np.asarray(out[1]).dtype == np.float32
+
+
+def test_int8_round_trip_preserves_dtype():
+    import ml_dtypes
+
+    kv = kvstore.create('local')
+    kv.set_gradient_compression({'type': 'int8'})
+    g = nd.array(np.ones((3,)), dtype="bfloat16")
+    rt = kv._compression.round_trip(g)
+    assert rt.dtype == np.dtype(ml_dtypes.bfloat16)

@@ -388,3 +388,28 @@ def test_pipeline_nan_safe_backward():
     assert np.isfinite(np.asarray(g_pp["w"])).all()
     g_seq = stack_stage_params(jax.grad(loss_seq)(per))
     assert float(jnp.abs(g_pp["w"] - g_seq["w"]).max()) < 1e-4
+
+
+def test_inject_aux_loss_gradient_semantics():
+    """inject_aux_loss: forward identity; backward adds d(aux)/d(inputs)
+    with coefficient 1 regardless of the downstream reduction."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.parallel.expert_parallel import inject_aux_loss
+
+    w = jnp.asarray(np.array([2.0, -1.0], "f"))
+    x = jnp.asarray(np.array([1.0, 3.0], "f"))
+
+    def loss(w):
+        y = x * w
+        aux = 0.5 * jnp.sum(w ** 2)
+        y = inject_aux_loss(y, aux)
+        return jnp.mean(y)  # downstream mean must NOT rescale aux
+
+    g = jax.grad(loss)(w)
+    expect = x / 2 + w  # d(mean(xw))/dw + d(0.5 w^2)/dw
+    assert np.allclose(np.asarray(g), np.asarray(expect), atol=1e-6)
+    # forward identity
+    assert float(loss(w)) == float(jnp.mean(x * w))

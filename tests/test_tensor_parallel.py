@@ -127,3 +127,36 @@ def test_tp_trainstep_matches_replicated():
                                rtol=2e-4, atol=2e-5)
     np.testing.assert_allclose(final_lm_head["replicated"],
                                final_lm_head["tp"], rtol=2e-3, atol=2e-4)
+
+
+def test_moe_expert_specs_and_rank_exact_rules():
+    """3-D stacked-expert weights shard over ep (not captured by the 2-D
+    tp rules); routers replicate."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.parallel import tensor_parallel as tp
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("tp", "ep"))
+
+    class A:
+        def __init__(self, shape):
+            self.shape = shape
+
+    params = {
+        "layers_0_mlp_gate_proj_weight": A((4, 16, 32)),   # MoE stacked
+        "layers_0_mlp_router_weight": A((16, 4)),
+        "layers_0_self_attn_q_proj_weight": A((32, 16)),   # dense 2-D
+    }
+    tspecs = tp.megatron_specs(params, mesh)
+    # 3-D expert weight NOT tp-sharded by the dense rule
+    assert tuple(tspecs["layers_0_mlp_gate_proj_weight"]) == ()
+    assert tuple(tspecs["layers_0_self_attn_q_proj_weight"]) == ("tp", None)
+    especs = tp.moe_expert_specs(params, mesh)
+    assert tuple(especs["layers_0_mlp_gate_proj_weight"]) == \
+        ("ep", None, None)
+    assert tuple(especs["layers_0_mlp_router_weight"]) == ()
+    merged = dict(tspecs)
+    merged.update(especs)
+    tp.validate_specs({k: v for k, v in params.items()}, merged, mesh)
