@@ -92,3 +92,22 @@ def test_resnet_nhwc_trains():
     w = [p for p in net.collect_params().values()
          if p.grad_req != "null"][0]
     assert np.isfinite(w.grad().asnumpy()).all()
+
+
+@pytest.mark.parametrize("name,size", [
+    ("resnet18_v2", 32), ("vgg11", 32), ("squeezenet1_0", 64),
+    ("mobilenet_v2_0_25", 32), ("densenet121", 32), ("alexnet", 64),
+])
+def test_zoo_hybridize_matches_eager(name, size):
+    """hybridize() (trace->jit) computes the same function as eager for
+    each zoo family (reference: test_gluon_model_zoo.py eager/hybrid
+    parity)."""
+    net = vision.get_model(name, classes=7)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).uniform(
+        -1, 1, (2, 3, size, size)).astype("f"))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    assert np.allclose(y_eager, y_hybrid, atol=1e-4), \
+        np.abs(y_eager - y_hybrid).max()
