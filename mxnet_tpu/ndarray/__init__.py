@@ -104,6 +104,44 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None, **kw):
                       transpose_b=transpose_b, out=out, **kw)
 
 
+# scalar-tolerant binary math (reference: mx.nd.maximum(x, 0) etc. accept
+# python scalars on either side).  Scalars dispatch to the registered
+# broadcast_*_scalar ops (scalar rides as an attr: no device constant, no
+# dtype promotion, output context follows the array operand) — the same
+# split the reference's _maximum_scalar path makes.
+def _scalar_tolerant(opname, scalar_op):
+    base_fn = getattr(_mod, opname)
+
+    def fn(lhs, rhs, *args, **kw):
+        lhs_s = isinstance(lhs, (int, float))
+        rhs_s = isinstance(rhs, (int, float))
+        if lhs_s and rhs_s:
+            return array(getattr(_np, opname)(
+                _np.float32(lhs), _np.float32(rhs)).reshape(()))
+        def coerce(scalar, arr):
+            # reference semantics: the scalar takes the array's dtype
+            # family (int scalar for int arrays), so no weak-type
+            # promotion to float32
+            if _np.issubdtype(arr.dtype, _np.integer):
+                return int(scalar)
+            return float(scalar)
+
+        if rhs_s:
+            return invoke(scalar_op, [lhs], {"scalar": coerce(rhs, lhs)})
+        if lhs_s:
+            return invoke(scalar_op, [rhs], {"scalar": coerce(lhs, rhs),
+                                             "reverse": True})
+        return base_fn(lhs, rhs, *args, **kw)
+
+    fn.__name__ = opname
+    fn.__doc__ = base_fn.__doc__
+    return fn
+
+
+for _n in ("maximum", "minimum", "power"):
+    setattr(_mod, _n, _scalar_tolerant(_n, f"broadcast_{_n}_scalar"))
+
+
 # -- convenience overrides with MXNet positional signatures ----------------
 def zeros(shape, ctx=None, dtype="float32", **kw):
     return invoke("zeros", [], {"shape": _shape_t(shape), "dtype": dtype}, ctx=ctx)

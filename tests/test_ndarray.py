@@ -178,3 +178,21 @@ def test_async_error_at_sync_point():
         b.asnumpy()
     # session still alive
     assert nd.ones((2,)).asnumpy().sum() == 2
+
+
+def test_binary_ops_accept_scalars():
+    """mx.nd.maximum(x, 0) / minimum / power take python scalars on either
+    side (reference nd surface); dtype and context follow the array."""
+    x = nd.array(np.array([-1.0, 0.5, 2.0], "f"))
+    assert np.allclose(nd.maximum(x, 0).asnumpy(), [0, 0.5, 2])
+    assert np.allclose(nd.maximum(0, x).asnumpy(), [0, 0.5, 2])
+    assert np.allclose(nd.minimum(x, 1.0).asnumpy(), [-1, 0.5, 1])
+    assert np.allclose(nd.power(x, 2).asnumpy(), [1, 0.25, 4])
+    # reverse semantics: scalar ** array, not array ** scalar
+    assert np.allclose(nd.power(2.0, nd.array([1.0, 3.0])).asnumpy(),
+                       [2, 8])
+    # dtype follows the array operand (no float32 forcing)
+    xi = nd.array(np.array([1, 5], "int32"), dtype="int32")
+    assert nd.maximum(xi, 3).dtype == np.dtype("int32")
+    # scalar-scalar degenerates to a host computation
+    assert float(nd.maximum(2, 3).asscalar()) == 3.0
