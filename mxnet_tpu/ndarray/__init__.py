@@ -112,12 +112,17 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None, **kw):
 def _scalar_tolerant(opname, scalar_op):
     base_fn = getattr(_mod, opname)
 
-    def fn(lhs, rhs, *args, **kw):
+    def fn(lhs, rhs, *args, out=None, ctx=None, **kw):
         lhs_s = isinstance(lhs, (int, float))
         rhs_s = isinstance(rhs, (int, float))
         if lhs_s and rhs_s:
-            return array(getattr(_np, opname)(
-                _np.float32(lhs), _np.float32(rhs)).reshape(()))
+            res = array(getattr(_np, opname)(
+                _np.float32(lhs), _np.float32(rhs)).reshape(()), ctx=ctx)
+            if out is not None:
+                out._set(res._get().astype(out._get().dtype))
+                return out
+            return res
+
         def coerce(scalar, arr):
             # reference semantics: the scalar takes the array's dtype
             # family (int scalar for int arrays), so no weak-type
@@ -127,11 +132,13 @@ def _scalar_tolerant(opname, scalar_op):
             return float(scalar)
 
         if rhs_s:
-            return invoke(scalar_op, [lhs], {"scalar": coerce(rhs, lhs)})
+            return invoke(scalar_op, [lhs], {"scalar": coerce(rhs, lhs)},
+                          out=out, ctx=ctx)
         if lhs_s:
             return invoke(scalar_op, [rhs], {"scalar": coerce(lhs, rhs),
-                                             "reverse": True})
-        return base_fn(lhs, rhs, *args, **kw)
+                                             "reverse": True},
+                          out=out, ctx=ctx)
+        return base_fn(lhs, rhs, *args, out=out, ctx=ctx, **kw)
 
     fn.__name__ = opname
     fn.__doc__ = base_fn.__doc__

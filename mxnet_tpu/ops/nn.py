@@ -751,3 +751,92 @@ def fused_rnn(rng_key, data, parameters, *maybe_states, state_size=None,
     if mode == "lstm":
         outs = outs + (jnp.stack(out_c, axis=0),)
     return outs
+
+
+# ==========================================================================
+# Spatial transformer family (reference: src/operator/
+# {grid_generator,bilinear_sampler,spatial_transformer}.cc — STN ops).
+# TPU-first: the sampling is a dense gather+lerp (fuses in XLA), the grid
+# math is elementwise; no atomics like the CUDA backward needed — jax
+# derives the scatter transpose.
+# ==========================================================================
+@register("GridGenerator", aliases=("grid_generator",))
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """affine: data (N, 6) -> sampling grid (N, 2, H, W) in [-1, 1]
+    (x then y rows, the reference's layout); warp: data (N, 2, H, W)
+    flow field -> normalized grid."""
+    jnp = _jnp()
+    if transform_type == "affine":
+        h, w = int(target_shape[0]), int(target_shape[1])
+        theta = data.reshape((-1, 2, 3))
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gx, gy = jnp.meshgrid(xs, ys)          # (h, w)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones]).reshape(3, -1)   # (3, h*w)
+        out = jnp.einsum("nij,jk->nik", theta, base)      # (n, 2, h*w)
+        return out.reshape((-1, 2, h, w))
+    if transform_type == "warp":
+        n, _, h, w = data.shape
+        ys = jnp.arange(h, dtype=data.dtype)
+        xs = jnp.arange(w, dtype=data.dtype)
+        gx, gy = jnp.meshgrid(xs, ys)
+        x = (data[:, 0] + gx) * (2.0 / max(w - 1, 1)) - 1.0
+        y = (data[:, 1] + gy) * (2.0 / max(h - 1, 1)) - 1.0
+        return jnp.stack([x, y], axis=1)
+    raise ValueError(f"unknown transform_type {transform_type}")
+
+
+def _bilinear_sample(data, grid):
+    """data (N,C,H,W), grid (N,2,h,w) normalized [-1,1] -> (N,C,h,w);
+    zero padding outside (reference BilinearSampler border semantics)."""
+    jnp = _jnp()
+    n, c, H, W = data.shape
+    x = (grid[:, 0] + 1.0) * (W - 1) / 2.0     # (n, h, w)
+    y = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    def gather(yi, xi):
+        inb = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xc = jnp.clip(xi, 0, W - 1).astype("int32")
+        yc = jnp.clip(yi, 0, H - 1).astype("int32")
+        # (n, c, h, w) gather per batch
+        v = data[jnp.arange(n)[:, None, None], :, yc, xc]   # (n,h,w,c)
+        v = jnp.moveaxis(v, -1, 1)
+        return v * inb[:, None, :, :]
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx_ = wx[:, None]
+    wy_ = wy[:, None]
+    return (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_)
+            + v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+
+
+@register("BilinearSampler", aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid, cudnn_off=None):
+    return _bilinear_sample(data, grid)
+
+
+@register("SpatialTransformer", aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=None):
+    """Affine STN: loc (N, 6) localization -> grid -> bilinear sample
+    (reference: spatial_transformer.cc — affine is the only transform the
+    reference op supports either)."""
+    if transform_type != "affine":
+        raise ValueError("SpatialTransformer supports transform_type="
+                         "'affine' only (reference parity); build warp "
+                         "grids with GridGenerator + BilinearSampler")
+    if sampler_type != "bilinear":
+        raise ValueError("SpatialTransformer supports sampler_type="
+                         "'bilinear' only")
+    grid = grid_generator(loc, transform_type="affine",
+                          target_shape=target_shape)
+    return _bilinear_sample(data, grid)

@@ -863,3 +863,60 @@ def index_array(data, axes=None):
     grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
     sel = [grids[a] for a in axes]
     return jnp.stack(sel, axis=-1).astype(_np.int64)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    """Broadcast lhs to rhs's shape (reference:
+    src/operator/tensor/broadcast_reduce_op_value.cc broadcast_like)."""
+    jnp = _jnp()
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    target = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        target[la] = rhs.shape[ra]
+    return jnp.broadcast_to(lhs, tuple(target))
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    """Per-row element pick: out[i] = a[i, indices[i]] (reference:
+    src/operator/tensor/indexing_op.cc batch_take)."""
+    jnp = _jnp()
+    idx = indices.astype("int32").reshape(-1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("multi_sum_sq")
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Sum of squares per input array (reference:
+    src/operator/contrib/multi_sum_sq.cc — the global-norm building block
+    for LAMB/clip_global_norm)."""
+    jnp = _jnp()
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32)))
+                      for a in arrays])
+
+
+@register("masked_softmax")
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0,
+                   normalize=True):
+    """Softmax with a boolean mask (reference:
+    src/operator/nn/softmax.cc masked_softmax, 1.x)."""
+    jnp = _jnp()
+    z = data / temperature
+    if mask is not None:
+        z = jnp.where(mask != 0, z, -jnp.inf)
+    z = z - jnp.max(jnp.where(jnp.isneginf(z), -1e30, z), axis=axis,
+                    keepdims=True)
+    e = jnp.exp(z)
+    if mask is not None:
+        e = jnp.where(mask != 0, e, 0.0)
+    denom = jnp.sum(e, axis=axis, keepdims=True)
+    return e / jnp.maximum(denom, 1e-30)
+
+
+@register("digamma")
+def digamma(x):
+    from jax.scipy.special import digamma as _dg
+
+    return _dg(x)

@@ -306,3 +306,99 @@ def test_grouped_deconvolution_vs_manual():
                         x[0, ci, i, j] * w[ci]
     assert out.shape == ref.shape
     assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_broadcast_like_and_batch_take():
+    a = nd.array(np.arange(3, dtype="f").reshape(3, 1))
+    b = nd.zeros((3, 4))
+    out = nd.broadcast_like(a, b)
+    assert out.shape == (3, 4)
+    assert np.allclose(out.asnumpy(), np.broadcast_to(
+        np.arange(3, dtype="f").reshape(3, 1), (3, 4)))
+    x = nd.array(np.arange(12, dtype="f").reshape(3, 4))
+    picked = nd.batch_take(x, nd.array([1, 3, 0], dtype="int32"))
+    assert np.allclose(picked.asnumpy(), [1, 7, 8])
+
+
+def test_multi_sum_sq_and_digamma():
+    a = nd.array(np.array([1.0, 2.0], "f"))
+    b = nd.array(np.array([[3.0], [4.0]], "f"))
+    out = nd.multi_sum_sq(a, b)
+    assert np.allclose(out.asnumpy(), [5.0, 25.0])
+    import scipy.special as sp  # noqa: F401
+    dg = nd.digamma(nd.array([1.0, 2.0, 5.0]))
+    assert np.allclose(dg.asnumpy(),
+                       [-0.5772157, 0.42278433, 1.5061177], atol=1e-5)
+
+
+def test_masked_softmax():
+    x = np.array([[1.0, 2.0, 3.0, 4.0]], "f")
+    m = np.array([[1, 1, 0, 1]], "f")
+    out = nd.masked_softmax(nd.array(x), nd.array(m)).asnumpy()
+    e = np.exp(x[0, [0, 1, 3]] - 4.0)
+    ref = e / e.sum()
+    assert np.allclose(out[0, [0, 1, 3]], ref, atol=1e-6)
+    assert out[0, 2] == 0.0
+
+
+def test_grid_generator_affine_identity_and_sampler():
+    """Identity affine grid samples the image unchanged; shifted grid
+    shifts it (reference: test_operator.py test_stn/bilinear sampler)."""
+    ident = nd.array(np.array([[1, 0, 0, 0, 1, 0]], "f"))
+    rs = np.random.RandomState(0)
+    img = nd.array(rs.randn(1, 2, 5, 5).astype("f"))
+    grid = nd.GridGenerator(ident, transform_type="affine",
+                            target_shape=(5, 5))
+    assert grid.shape == (1, 2, 5, 5)
+    out = nd.BilinearSampler(img, grid)
+    assert np.allclose(out.asnumpy(), img.asnumpy(), atol=1e-5)
+    # SpatialTransformer with identity loc == input
+    out2 = nd.SpatialTransformer(img, ident, target_shape=(5, 5),
+                                 transform_type="affine",
+                                 sampler_type="bilinear")
+    assert np.allclose(out2.asnumpy(), img.asnumpy(), atol=1e-5)
+    # half-pixel x-shift: interior columns become the mean of neighbors
+    shift = nd.array(np.array([[1, 0, 0.25, 0, 1, 0]], "f"))
+    out3 = nd.SpatialTransformer(img, shift, target_shape=(5, 5),
+                                 transform_type="affine",
+                                 sampler_type="bilinear").asnumpy()
+    ref = 0.5 * (img.asnumpy()[..., 1:3] + img.asnumpy()[..., 2:4])
+    assert np.allclose(out3[..., 1:3], ref, atol=1e-5)
+
+
+def test_spatial_transformer_gradient_flows():
+    from mxnet_tpu import autograd
+
+    loc = nd.array(np.array([[1, 0, 0.1, 0, 1, -0.1]], "f"))
+    loc.attach_grad()
+    img = nd.array(np.random.RandomState(1).randn(1, 1, 6, 6).astype("f"))
+    with autograd.record():
+        out = nd.SpatialTransformer(img, loc, target_shape=(6, 6),
+                                    transform_type="affine",
+                                    sampler_type="bilinear")
+        loss = (out * out).sum()
+    loss.backward()
+    g = loc.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_scalar_binary_out_kwarg():
+    """out= works on the scalar paths (review finding: it was dropped)."""
+    x = nd.array(np.array([-1.0, 2.0], "f"))
+    y = nd.zeros((2,))
+    r = nd.maximum(x, 0, out=y)
+    assert r is y and np.allclose(y.asnumpy(), [0, 2])
+    r2 = nd.maximum(2, 5, out=y[0:1].reshape((1,))) if False else None
+    z = nd.zeros((2,))
+    r3 = nd.power(2.0, nd.array([1.0, 3.0]), out=z)
+    assert r3 is z and np.allclose(z.asnumpy(), [2, 8])
+
+
+def test_spatial_transformer_rejects_unsupported_modes():
+    import pytest
+
+    img = nd.ones((1, 1, 4, 4))
+    loc = nd.array(np.array([[1, 0, 0, 0, 1, 0]], "f"))
+    with pytest.raises(Exception):
+        nd.SpatialTransformer(img, loc, target_shape=(4, 4),
+                              transform_type="warp")
