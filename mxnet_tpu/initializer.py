@@ -31,6 +31,20 @@ def create(name, **kwargs):
     return _REG.create(name, **kwargs)
 
 
+def _create_from_dumps(s):
+    """Rebuild an initializer from ``Initializer.dumps()`` JSON (the string
+    form a variable's ``__init__`` attr serializes to) or a bare name."""
+    import json
+
+    try:
+        payload = json.loads(s)
+    except (TypeError, ValueError):
+        return create(str(s))
+    if isinstance(payload, list) and payload:
+        return create(payload[0], **(payload[1] if len(payload) > 1 else {}))
+    return create(str(payload))
+
+
 class InitDesc(str):
     """Parameter name + attrs hint (reference: mxnet.initializer.InitDesc)."""
 
@@ -46,6 +60,18 @@ class Initializer:
         self._kwargs = kwargs
 
     def __call__(self, desc, arr):
+        # per-variable override: sym.var(..., init=...) lands in the
+        # variable's attrs as "__init__" (reference: Initializer.__call__
+        # honoring InitDesc.attrs['__init__'], initializer.py upstream)
+        override = getattr(desc, "attrs", None)
+        override = override.get("__init__") if override else None
+        if override is not None and override is not self:
+            init = override if isinstance(override, Initializer) else \
+                _create_from_dumps(override)
+            # call the payload directly — re-dispatching by name suffix
+            # would send e.g. an LSTMBias'd *_bias var back to _init_zero
+            init._init_weight(str(desc), arr)
+            return
         if not isinstance(desc, str):
             desc = str(desc)
         name = desc.lower()
@@ -225,9 +251,10 @@ class LSTMBias(Initializer):
         self.forget_bias = forget_bias
 
     def _init_weight(self, name, arr):
-        arr[:] = 0.0
+        import numpy as np
+
+        a = np.zeros(arr.shape, dtype="float32")
         n = arr.shape[0] // 4
-        a = arr.asnumpy()
         a[n:2 * n] = self.forget_bias
         arr[:] = a
 

@@ -127,6 +127,7 @@ class Module(BaseModule):
         from ..ndarray import NDArray
 
         initializer = initializer or _init.Uniform(0.01)
+        var_attrs = self._symbol.attr_dict()
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
@@ -138,7 +139,7 @@ class Module(BaseModule):
                 raise MXNetError(f"parameter {name!r} missing from arg_params "
                                  "(pass allow_missing=True to initialize it)")
             else:
-                initializer(_init.InitDesc(name), arr)
+                initializer(_init.InitDesc(name, var_attrs.get(name)), arr)
         for name in self._aux_names:
             arr = self._exec.aux_dict[name]
             if aux_params is not None and name in aux_params:

@@ -29,6 +29,18 @@ def _jnp():
     return jnp
 
 
+def _collect_nd(a, path, paths, nd_args):
+    """Record NDArray leaves under ``path`` (recursing through nested
+    list/tuple structure, e.g. jnp.block's [[a], [b]]) into parallel
+    paths/values lists."""
+    if isinstance(a, NDArray):
+        paths.append(path)
+        nd_args.append(a)
+    elif isinstance(a, (list, tuple)):
+        for j, e in enumerate(a):
+            _collect_nd(e, path + (j,), paths, nd_args)
+
+
 def array(obj, dtype=None, ctx=None):
     v = obj._get() if isinstance(obj, NDArray) else _onp.asarray(obj)
     out = _jnp().asarray(v, dtype=dtype)
@@ -39,19 +51,36 @@ def empty(shape, dtype="float32", ctx=None):
     return NDArray._from_jax(_jnp().zeros(shape, dtype), ctx)
 
 
+def _substitute(container, path, v):
+    """Write ``v`` at ``path``, copying each nested list/tuple along the
+    way so the caller's containers are never mutated."""
+    if len(path) == 1:
+        container[path[0]] = v
+        return
+    child = list(container[path[0]])
+    container[path[0]] = child
+    _substitute(child, path[1:], v)
+
+
 def _wrap_fn(fn, name):
     def wrapped(*args, **kwargs):
-        nd_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
-        nd_kw = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
-        nd_args = [args[i] for i in nd_pos] + [kwargs[k] for k in nd_kw]
+        # collect NDArray operands at top level AND one level inside
+        # list/tuple arguments — sequence-taking jax.numpy APIs
+        # (concatenate, stack, vstack, block) receive arrays in a list and
+        # must still route through apply_fn so autograd sees them
+        paths, nd_args = [], []
+        for i, a in enumerate(args):
+            _collect_nd(a, ("a", i), paths, nd_args)
+        for k, v in kwargs.items():
+            _collect_nd(v, ("k", k), paths, nd_args)
 
         def pure(*vals):
-            full = list(args)
-            kw = dict(kwargs)
-            for i, v in zip(nd_pos, vals[:len(nd_pos)]):
-                full[i] = v
-            for k, v in zip(nd_kw, vals[len(nd_pos):]):
-                kw[k] = v
+            full = [list(a) if isinstance(a, (list, tuple)) else a
+                    for a in args]
+            kw = {k: list(v) if isinstance(v, (list, tuple)) else v
+                  for k, v in kwargs.items()}
+            for path, v in zip(paths, vals):
+                _substitute(full if path[0] == "a" else kw, path[1:], v)
             return fn(*full, **kw)
 
         if nd_args:

@@ -50,11 +50,15 @@ def moe_apply(expert_fn, expert_params, router_weight, x, mesh=None,
     gate = jnp.take_along_axis(gates, expert_idx[:, None], axis=1)[:, 0]
     sel = jax.nn.one_hot(expert_idx, E, dtype=x.dtype)   # (T, E)
 
-    # position of each token within its expert's queue; >= C drops
-    pos = jnp.cumsum(sel, axis=0) * sel - 1.0            # (T, E)
+    # position of each token within its expert's queue; >= C drops.
+    # Counted in int32, NOT x.dtype: with bf16 activations integer counts
+    # above 256 are unrepresentable and queue positions would collide,
+    # silently merging/dropping tokens.
+    sel_i = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)
+    pos = jnp.cumsum(sel_i, axis=0) * sel_i - 1          # (T, E) int32
     keep = (pos >= 0) & (pos < C)
     dispatch = sel[:, :, None] * jax.nn.one_hot(
-        jnp.clip(pos, 0, C - 1).astype(jnp.int32), C,
+        jnp.clip(pos, 0, C - 1), C,
         dtype=x.dtype)                                   # (T, E, C)
     dispatch = dispatch * keep.astype(x.dtype)[:, :, None]
     combine = dispatch * gate[:, None, None]
@@ -77,12 +81,13 @@ def moe_apply(expert_fn, expert_params, router_weight, x, mesh=None,
             expert_out, NamedSharding(mesh, P(axis, None, None)))
     out = jnp.einsum("tec,ecd->td", combine, expert_out)
 
-    # Switch load-balance loss: E * sum_e f_e * p_e
-    f = sel.mean(axis=0)                                  # fraction routed
-    p = gates.mean(axis=0)                                # mean router prob
+    # Switch load-balance loss: E * sum_e f_e * p_e.  Stats accumulate in
+    # int32/fp32 — summing a bf16 one-hot over >256 tokens saturates.
+    f = sel_i.astype(jnp.float32).mean(axis=0)            # fraction routed
+    p = gates.astype(jnp.float32).mean(axis=0)            # mean router prob
     aux = {"load_balance_loss": E * jnp.sum(f * p),
-           "expert_load": sel.sum(axis=0),
-           "dropped": T - jnp.sum(dispatch)}
+           "expert_load": sel_i.sum(axis=0),
+           "dropped": T - jnp.sum(keep.astype(jnp.int32))}
     return out, aux
 
 

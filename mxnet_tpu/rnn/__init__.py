@@ -13,6 +13,7 @@ BucketingModule executors had.
 from __future__ import annotations
 
 from ..base import MXNetError
+from .. import initializer as _init
 from .. import symbol as _sym
 
 __all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
@@ -27,10 +28,10 @@ class BaseRNNCell:
         self._params = {}
         self._counter = 0
 
-    def _get_param(self, name):
+    def _get_param(self, name, init=None):
         full = self._prefix + name
         if full not in self._params:
-            self._params[full] = _sym.var(full)
+            self._params[full] = _sym.var(full, init=init)
         return self._params[full]
 
     @property
@@ -88,8 +89,13 @@ class BaseRNNCell:
             out, states = self(seq[t], states)
             outputs.append(out)
         if merge_outputs:
+            # stack on the T axis of the requested layout: axis 1 for NTC,
+            # axis 0 for TNC (reference: BaseRNNCell.unroll's
+            # layout.find('T') axis selection)
+            t_axis = 1 if layout == "NTC" else 0
             outputs = _sym.Concat(
-                *[_sym.expand_dims(o, axis=1) for o in outputs], dim=1)
+                *[_sym.expand_dims(o, axis=t_axis) for o in outputs],
+                dim=t_axis)
         return outputs, states
 
 
@@ -137,9 +143,14 @@ class LSTMCell(BaseRNNCell):
     def __call__(self, inputs, states):
         name = f"{self._prefix}t{self._counter}_"
         nh = self._num_hidden
-        i2h = _sym.FullyConnected(inputs, self._get_param("i2h_weight"),
-                                  self._get_param("i2h_bias"),
-                                  num_hidden=nh * 4, name=name + "i2h")
+        # forget_bias is baked into the i2h_bias initializer (reference:
+        # LSTMBiasInit parameterization) — NOT added in the forward pass,
+        # so reference-trained .params load without a gate shift
+        i2h = _sym.FullyConnected(
+            inputs, self._get_param("i2h_weight"),
+            self._get_param("i2h_bias",
+                            init=_init.LSTMBias(self._forget_bias)),
+            num_hidden=nh * 4, name=name + "i2h")
         h2h = _sym.FullyConnected(states[0], self._get_param("h2h_weight"),
                                   self._get_param("h2h_bias"),
                                   num_hidden=nh * 4, name=name + "h2h")
@@ -147,8 +158,7 @@ class LSTMCell(BaseRNNCell):
         sliced = _sym.SliceChannel(gates, num_outputs=4, axis=1,
                                    name=name + "slice")
         in_gate = _sym.Activation(sliced[0], act_type="sigmoid")
-        forget_gate = _sym.Activation(sliced[1] + self._forget_bias,
-                                      act_type="sigmoid")
+        forget_gate = _sym.Activation(sliced[1], act_type="sigmoid")
         in_trans = _sym.Activation(sliced[2], act_type="tanh")
         out_gate = _sym.Activation(sliced[3], act_type="sigmoid")
         next_c = forget_gate * states[1] + in_gate * in_trans

@@ -673,6 +673,10 @@ def var(name, attr=None, shape=None, dtype=None, init=None, stype=None,
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
         attrs["__dtype__"] = str(dtype)
+    if init is not None:
+        # per-variable initializer override; honored by Initializer.__call__
+        # via InitDesc.attrs (reference: sym.var(init=...) semantics)
+        attrs["__init__"] = init if isinstance(init, str) else init.dumps()
     attrs.update(kwargs)
     return Symbol([(_Node(None, name, attrs), 0)])
 

@@ -97,3 +97,20 @@ def test_npx_set_np_flags_honored():
     mx.npx.set_np(shape=False, array=False)
     assert not mx.npx.is_np_shape() and not mx.npx.is_np_array()
     mx.npx.reset_np()
+
+
+def test_np_sequence_args_route_through_autograd():
+    """Sequence-taking APIs (concatenate/stack/vstack) find NDArrays one
+    level inside list arguments and route them through apply_fn so
+    gradients flow (advisor finding r4)."""
+    a = mx.np.array([1.0, 2.0])
+    b = mx.np.array([3.0, 4.0])
+    c = mx.np.concatenate([a, b])
+    assert isinstance(c, mx.nd.NDArray)
+    assert onp.allclose(c.asnumpy(), [1, 2, 3, 4])
+    assert onp.allclose(mx.np.vstack((a, b)).asnumpy(), [[1, 2], [3, 4]])
+    a.attach_grad()
+    with autograd.record():
+        y = mx.np.sum(mx.np.stack([a * 2.0, b]))
+    y.backward()
+    assert onp.allclose(a.grad.asnumpy(), [2.0, 2.0])

@@ -413,3 +413,35 @@ def test_inject_aux_loss_gradient_semantics():
     assert np.allclose(np.asarray(g), np.asarray(expect), atol=1e-6)
     # forward identity
     assert float(loss(w)) == float(jnp.mean(x * w))
+
+
+def test_moe_bf16_queue_positions_do_not_collide():
+    """Expert-queue positions are counted in int32: with bf16 activations
+    and >256 tokens routed to one expert, a cumsum in x.dtype would make
+    positions collide above 256 and silently merge/drop tokens (advisor
+    finding r4)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.parallel.expert_parallel import (moe_apply,
+                                                    stack_expert_params)
+
+    T, d, E = 600, 4, 2
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(T, d).astype("f")).astype(jnp.bfloat16)
+    # zero router: argmax ties resolve to index 0, so every token routes
+    # to expert 0 regardless of input sign
+    wr = jnp.zeros((d, E), jnp.bfloat16)
+    params = stack_expert_params(
+        [{"w": jnp.asarray(rs.randn(d, d).astype("f") * 0.3
+                           ).astype(jnp.bfloat16)} for _ in range(E)])
+
+    def expert_fn(p, toks):
+        return jnp.tanh(toks @ p["w"])
+
+    # capacity_factor=E makes C == T: nothing may be dropped
+    out, aux = moe_apply(expert_fn, params, wr, x, mesh=None,
+                         capacity_factor=float(E))
+    assert float(aux["dropped"]) == 0.0, aux["dropped"]
+    assert float(aux["expert_load"][0]) == T
+    assert np.isfinite(np.asarray(out, dtype="f")).all()

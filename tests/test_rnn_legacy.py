@@ -263,3 +263,51 @@ def test_fused_rnn_dropout_active_in_executor_training():
     y_infer = ex.forward(is_train=False)[0].asnumpy()
     # dropout 0.9 between layers makes train output differ from inference
     assert not np.allclose(y_train, y_infer, atol=1e-6)
+
+
+def test_unroll_tnc_merges_on_time_axis():
+    """layout='TNC' + merge_outputs=True stacks on the T axis (axis 0),
+    not axis 1 (advisor finding r4; reference: BaseRNNCell.unroll's
+    layout.find('T') axis selection)."""
+    cell = mx.rnn.RNNCell(5, prefix="tnc_")
+    outputs, _ = cell.unroll(3, mx.sym.var("data"), layout="TNC",
+                             merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(3, 2, 4))
+    assert out_shapes[0] == (3, 2, 5)
+    # and the values match the NTC unroll transposed
+    cell2 = mx.rnn.RNNCell(5, prefix="tnc_")
+    out_ntc, _ = cell2.unroll(3, mx.sym.var("data2"), layout="NTC",
+                              merge_outputs=True)
+    rs = np.random.RandomState(3)
+    x = rs.randn(3, 2, 4).astype("f")
+    feed = {}
+    shapes, _, _ = outputs.infer_shape(data=(3, 2, 4))
+    for name, shp in zip(outputs.list_arguments(), shapes):
+        feed[name] = mx.nd.array(x if name == "data"
+                                 else rs.randn(*shp).astype("f") * 0.1)
+    y_tnc = outputs.bind(mx.cpu(), feed).forward()[0].asnumpy()
+    feed2 = {"data2" if k == "data" else k:
+             (mx.nd.array(x.transpose(1, 0, 2)) if k == "data" else v)
+             for k, v in feed.items()}
+    y_ntc = out_ntc.bind(mx.cpu(), feed2).forward()[0].asnumpy()
+    assert np.allclose(y_tnc, y_ntc.transpose(1, 0, 2), atol=1e-5)
+
+
+def test_lstm_forget_bias_in_initializer_not_forward():
+    """forget_bias is baked into the i2h_bias initializer (reference:
+    LSTMBiasInit parameterization), NOT added every forward step, so
+    reference-trained .params load without a shifted forget gate
+    (advisor finding r4)."""
+    from mxnet_tpu.module import Module
+
+    cell = mx.rnn.LSTMCell(4, prefix="fb_", forget_bias=2.0)
+    outputs, _ = cell.unroll(2, mx.sym.var("data"), merge_outputs=True)
+    mod = Module(outputs, data_names=("data",), label_names=(),
+                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1, 2, 3))], label_shapes=None,
+             for_training=False)
+    mod.init_params(initializer=mx.init.Zero())
+    arg, _ = mod.get_params()
+    bias = arg["fb_i2h_bias"].asnumpy()
+    assert np.allclose(bias[4:8], 2.0), bias
+    assert np.allclose(bias[:4], 0.0) and np.allclose(bias[8:], 0.0)
