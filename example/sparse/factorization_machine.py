@@ -5,11 +5,111 @@ The csr x dense products run through the framework's differentiable SpMM
 (segment-sum over nonzeros, gradients to the dense factors), so the model
 trains without ever densifying the feature matrix.
 
-CPU smoke: python factorization_machine.py --cpu --steps 60
+Two training modes:
+
+- local (default): parameters are NDArrays, manual SGD on autograd grads.
+- ``--kvstore``: parameters live SERVER-SIDE in a kvstore (host-resident
+  row-sparse tables — reference: kvstore_dist_server.h
+  DataHandleRowSparse).  Each step ``row_sparse_pull``s only the rows the
+  batch touches, pushes row-sparse gradients back, and the server applies
+  the lazy optimizer update to those rows only — bytes moved per step
+  scale with the batch's feature support, not the table size.
+
+CPU smoke: python factorization_machine.py --cpu --steps 60 [--kvstore]
 """
 import argparse
 
 import numpy as np
+
+
+def run(num_features=1000, rank=8, batch_size=128, steps=200, lr=1.0,
+        density=0.02, use_kvstore=False, log_every=20, seed=0):
+    """Train; returns the per-step loss list (both modes follow the same
+    random stream, so trajectories are comparable across modes)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+
+    rs = np.random.RandomState(seed)
+    D, K, B = num_features, rank, batch_size
+
+    # ground-truth sparse logistic model for synthetic clicks
+    true_w = rs.randn(D) * (rs.rand(D) < 0.1)
+
+    def sample_batch():
+        dense = (rs.rand(B, D) < density) * rs.rand(B, D).astype("f")
+        y = (dense @ true_w + 0.1 * rs.randn(B) > 0).astype("f")
+        return dense.astype("f"), y
+
+    w0 = nd.zeros((1,))
+    w0.attach_grad()
+    w_init = np.zeros((D, 1), "f")
+    V_init = (rs.randn(D, K) * 0.01).astype("f")
+
+    if use_kvstore:
+        from mxnet_tpu import optimizer as opt
+
+        kv = mx.kv.create("local")
+        kv.init("w", nd.array(w_init))
+        kv.init("V", nd.array(V_init))
+        kv.set_optimizer(opt.create("sgd", learning_rate=lr, wd=0.0,
+                                    rescale_grad=1.0))
+    else:
+        w = nd.array(w_init)
+        V = nd.array(V_init)
+        for p in (w, V):
+            p.attach_grad()
+
+    def fm_loss(x_csr, x_sq_csr, wv, Vv, y):
+        linear = nd.dot(x_csr, wv)[:, 0]                    # SpMM
+        xv = nd.dot(x_csr, Vv)                              # (B, K)
+        x2v2 = nd.dot(x_sq_csr, Vv * Vv)                    # (B, K)
+        pairwise = 0.5 * (xv * xv - x2v2).sum(axis=1)
+        logit = w0 + linear + pairwise
+        # logistic loss
+        return (nd.log(1 + nd.exp(-nd.abs(logit)))
+                + nd.relu(logit) - logit * y).mean()
+
+    losses = []
+    for step in range(steps):
+        dense, y_np = sample_batch()
+        y = nd.array(y_np)
+        if use_kvstore:
+            # only the batch's feature support moves: pull those rows,
+            # train on the column-compressed batch, push rsp grads back
+            touched = np.nonzero(dense.any(axis=0))[0].astype("i")
+            T = len(touched)
+            xc = dense[:, touched]
+            x_csr = nd.array(xc).tostype("csr")
+            x_sq = nd.array(np.square(xc)).tostype("csr")
+            w_rows = nd.zeros((T, 1))
+            V_rows = nd.zeros((T, K))
+            kv.row_sparse_pull("w", out=w_rows, row_ids=nd.array(touched))
+            kv.row_sparse_pull("V", out=V_rows, row_ids=nd.array(touched))
+            w_rows.attach_grad()
+            V_rows.attach_grad()
+            with autograd.record():
+                loss = fm_loss(x_csr, x_sq, w_rows, V_rows, y)
+            loss.backward()
+            from mxnet_tpu.ndarray.sparse import row_sparse_array
+
+            kv.push("w", row_sparse_array(
+                (w_rows.grad.asnumpy(), touched), shape=(D, 1)))
+            kv.push("V", row_sparse_array(
+                (V_rows.grad.asnumpy(), touched), shape=(D, K)))
+        else:
+            x_csr = nd.array(dense).tostype("csr")
+            x_sq = nd.array(np.square(dense)).tostype("csr")
+            with autograd.record():
+                loss = fm_loss(x_csr, x_sq, w, V, y)
+            loss.backward()
+            for p in (w, V):
+                p -= lr * p.grad
+        w0 -= lr * w0.grad
+        losses.append(float(loss.asnumpy()))
+        if log_every and step % log_every == 0:
+            print(f"step {step}: logloss {losses[-1]:.4f}")
+    print(f"final logloss {losses[-1]:.4f} (start {losses[0]:.4f})")
+    return losses
 
 
 def main():
@@ -20,6 +120,7 @@ def main():
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--lr", type=float, default=1.0)
     ap.add_argument("--density", type=float, default=0.02)
+    ap.add_argument("--kvstore", action="store_true")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:
@@ -27,47 +128,9 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
 
-    import mxnet_tpu as mx
-    from mxnet_tpu import autograd, nd
-
-    rs = np.random.RandomState(0)
-    D, K, B = args.num_features, args.rank, args.batch_size
-
-    # ground-truth sparse logistic model for synthetic clicks
-    true_w = rs.randn(D) * (rs.rand(D) < 0.1)
-
-    def sample_batch():
-        dense = (rs.rand(B, D) < args.density) * rs.rand(B, D).astype("f")
-        y = (dense @ true_w + 0.1 * rs.randn(B) > 0).astype("f")
-        return nd.array(dense.astype("f")).tostype("csr"), nd.array(y)
-
-    w0 = nd.zeros((1,))
-    w = nd.zeros((D, 1))
-    V = nd.array((rs.randn(D, K) * 0.01).astype("f"))
-    for p in (w0, w, V):
-        p.attach_grad()
-
-    losses = []
-    for step in range(args.steps):
-        x_csr, y = sample_batch()
-        x_sq = nd.array(np.square(x_csr.asnumpy() if hasattr(x_csr, "asnumpy")
-                                  else x_csr)).tostype("csr")
-        with autograd.record():
-            linear = nd.dot(x_csr, w)[:, 0]                     # SpMM
-            xv = nd.dot(x_csr, V)                               # (B, K)
-            x2v2 = nd.dot(x_sq, V * V)                          # (B, K)
-            pairwise = 0.5 * (xv * xv - x2v2).sum(axis=1)
-            logit = w0 + linear + pairwise
-            # logistic loss
-            loss = (nd.log(1 + nd.exp(-nd.abs(logit)))
-                    + nd.relu(logit) - logit * y).mean()
-        loss.backward()
-        for p in (w0, w, V):
-            p -= args.lr * p.grad
-        losses.append(float(loss.asnumpy()))
-        if step % 20 == 0:
-            print(f"step {step}: logloss {losses[-1]:.4f}")
-    print(f"final logloss {losses[-1]:.4f} (start {losses[0]:.4f})")
+    losses = run(num_features=args.num_features, rank=args.rank,
+                 batch_size=args.batch_size, steps=args.steps, lr=args.lr,
+                 density=args.density, use_kvstore=args.kvstore)
     assert losses[-1] < losses[0]
 
 

@@ -41,6 +41,27 @@ def _as_list(x):
     return x if isinstance(x, (list, tuple)) else [x]
 
 
+class _HostRowSparseTable:
+    """Server-side host-resident weight for row-sparse keys.
+
+    Reference: the dist server's ``DataHandleRowSparse``
+    (``src/kvstore/kvstore_dist_server.h`` — SURVEY.md §3.3/§4.4) keeps the
+    table server-side and moves only touched rows per push/pull.  The
+    TPU-native equivalent: the table lives in HOST memory (the idiom for
+    embedding tables larger than HBM); ``row_sparse_pull`` gathers rows on
+    host and device_puts only those rows, and sparse pushes update only the
+    gradient's rows through the optimizer's own kernels on row slices.
+    ``bytes_h2d``/``bytes_d2h`` count actual host<->device row traffic so
+    tests can assert it scales with touched rows, not table size.
+    """
+
+    def __init__(self, dense_np):
+        self.table = _np.array(dense_np)      # full table, host memory
+        self.state = None                     # host optimizer-state leaves
+        self.bytes_h2d = 0
+        self.bytes_d2h = 0
+
+
 class KVStore:
     """Single-process KVStore covering local/device/nccl semantics."""
 
@@ -50,6 +71,7 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression = None
+        self._pending_host_state = {}
 
     @property
     def type(self):
@@ -65,16 +87,49 @@ class KVStore:
 
     # -- core API ----------------------------------------------------------
     def init(self, key, value):
+        from .ndarray.sparse import RowSparseNDArray
+
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
-            self._store[k] = v.copy()
+            if isinstance(v, RowSparseNDArray):
+                # row-sparse-initialized keys live server-side on host
+                self._store[k] = _HostRowSparseTable(_np.asarray(v._get()))
+            else:
+                self._store[k] = v.copy()
 
     def push(self, key, value, priority=0):
         """Reduce values (one per device) into the store buffer.
         Reference: KVStoreLocal::PushImpl -> CommDevice::Reduce."""
+        from .ndarray.sparse import RowSparseNDArray
+
         keys, grouped = _group_key_value(key, value)
         for k, vals in zip(keys, grouped):
             reduced = _reduce(vals)
+            if (isinstance(reduced, RowSparseNDArray)
+                    and self._updater is not None
+                    and self._optimizer is not None
+                    and self._compression is None
+                    and getattr(self._optimizer, "lazy_update", True)
+                    and not getattr(self, "_sharded_update", False)):
+                host = self._ensure_host_table(k)
+                if host is not None:
+                    self._sparse_lazy_update(k, host, reduced)
+                    continue
+            if isinstance(self._store.get(k), _HostRowSparseTable):
+                host = self._store[k]
+                if (self._updater is not None
+                        and self._optimizer is not None
+                        and self._compression is None
+                        and not isinstance(reduced, RowSparseNDArray)
+                        and not getattr(self, "_sharded_update", False)):
+                    # dense gradient on a host-resident key: apply the
+                    # optimizer over all rows in place — no demote, so the
+                    # host state survives sparse<->dense transitions
+                    self._host_dense_update(k, host, reduced)
+                    continue
+                # no updater (or compression/sharded): demote, handing any
+                # accumulated host state back to the updater
+                self._store[k] = self._demote(k)
             if self._compression is not None:
                 reduced = self._compression.round_trip(reduced, key=k)
             if self._updater is not None:
@@ -90,6 +145,8 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized in kvstore")
             src = self._store[k]
+            if isinstance(src, _HostRowSparseTable):
+                src = self._materialize(k)
             for o in outs:
                 o._set(src.as_in_context(o.context)._get().astype(o._get().dtype))
 
@@ -98,8 +155,11 @@ class KVStore:
         self.pull(key, out if out is not None else value, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the requested rows (reference: sparse embedding path).
-        Dense emulation: gather rows after a full pull."""
+        """Pull only the requested rows (reference: the dist server's
+        DataHandleRowSparse, src/kvstore/kvstore_dist_server.h — SURVEY.md
+        §3.3/§4.4).  Host-resident keys gather rows on host and device_put
+        only those rows: bytes moved scale with len(row_ids), not with the
+        table size."""
         if row_ids is None:
             return self.pull(key, out, priority)
         outs = _as_list(out)
@@ -112,6 +172,25 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized in kvstore")
             src = self._store[k]
+            if not isinstance(src, _HostRowSparseTable) and \
+                    not getattr(self, "_sharded_update", False):
+                val = src._get()
+                sh = getattr(val, "sharding", None)
+                if sh is None or len(sh.device_set) <= 1:
+                    # promote: from here on this key serves rows host-side
+                    src = self._ensure_host_table(k)
+            if isinstance(src, _HostRowSparseTable):
+                import jax.numpy as jnp
+
+                rid = _np.asarray(r._get() if isinstance(r, NDArray)
+                                  else r).astype(_np.int64)
+                rid = _np.clip(rid, 0, src.table.shape[0] - 1)
+                rows = src.table[rid]             # host gather: O(rows)
+                src.bytes_h2d += rows.nbytes
+                nd_rows = NDArray._from_jax(
+                    jnp.asarray(rows)).as_in_context(o.context)
+                o._set(nd_rows._get().astype(o._get().dtype))
+                continue
             src_val = src._get()
             sharding = getattr(src_val, "sharding", None)
             if sharding is not None and len(sharding.device_set) > 1:
@@ -128,6 +207,143 @@ class KVStore:
             src_local = src.as_in_context(o.context)
             rows = invoke("take", [src_local, r], {"axis": 0, "mode": "clip"})
             o._set(rows._get().astype(o._get().dtype))
+
+    # -- host-resident row-sparse machinery --------------------------------
+    def _ensure_host_table(self, k):
+        """Promote key ``k``'s stored weight to a host-resident table.
+        Returns the table, or None if the key cannot be served host-side
+        (weight is a multi-device sharded global array)."""
+        cur = self._store[k]
+        if isinstance(cur, _HostRowSparseTable):
+            return cur
+        val = cur._get()
+        sharding = getattr(val, "sharding", None)
+        if sharding is not None and len(sharding.device_set) > 1:
+            return None
+        host = _HostRowSparseTable(_np.asarray(val))  # one-time D2H
+        if k in self._pending_host_state:
+            # state saved by save_optimizer_states before this key was
+            # re-promoted in the restored process
+            host.state = self._pending_host_state.pop(k)
+        self._store[k] = host
+        return host
+
+    def _ensure_host_state(self, k, host, probe_nd):
+        """Create (or adopt) the host-resident optimizer state for key
+        ``k``: full-height numpy mirrors of every state leaf.  Dense state
+        already accumulated in the Updater is adopted, so promotion does
+        not silently reset momentum/adam moments."""
+        if host.state is not None:
+            return
+        idx = _key_int(k)
+        adopted = None
+        if self._updater is not None and idx in getattr(
+                self._updater, "states", {}):
+            adopted = self._updater.states.pop(idx)
+            self._updater.states_synced.pop(idx, None)
+        if adopted is not None:
+            leaves, treedef = _flatten_state(adopted)
+            host.state = ([None if lv is None else
+                           _np.array(_np.asarray(lv._get()))
+                           for lv in leaves], treedef)
+            return
+        probe = self._optimizer.create_state_multi_precision(idx, probe_nd)
+        leaves, treedef = _flatten_state(probe)
+        host.state = ([None if lv is None else
+                       _np.zeros((host.table.shape[0],)
+                                 + tuple(_np.asarray(lv._get()).shape[1:]),
+                                 _np.asarray(lv._get()).dtype)
+                       for lv in leaves], treedef)
+
+    def _demote(self, k):
+        """Turn a host-resident key back into a device NDArray, handing
+        accumulated host optimizer state to the Updater so it survives."""
+        import jax.numpy as jnp
+
+        host = self._store[k]
+        if host.state is not None and self._updater is not None:
+            leaves, treedef = host.state
+            idx = _key_int(k)
+            self._updater.states[idx] = _unflatten_state(
+                [None if lv is None else NDArray._from_jax(jnp.asarray(lv))
+                 for lv in leaves], treedef)
+            self._updater.states_synced[idx] = True
+        return NDArray._from_jax(jnp.asarray(host.table))
+
+    def _host_dense_update(self, k, host, grad):
+        """Dense gradient against a host-resident key: run the optimizer
+        over all rows in place (one full-table round trip — unavoidable for
+        a dense grad) keeping the host state authoritative."""
+        import jax.numpy as jnp
+
+        idx = _key_int(k)
+        w_nd = NDArray._from_jax(jnp.asarray(host.table))
+        host.bytes_h2d += host.table.nbytes
+        self._ensure_host_state(k, host, w_nd)
+        leaves, treedef = host.state
+        state_nds = [None if lv is None else NDArray._from_jax(
+            jnp.asarray(lv)) for lv in leaves]
+        state = _unflatten_state(state_nds, treedef)
+        self._optimizer.update_multi_precision(idx, w_nd, grad, state)
+        host.table[...] = _np.asarray(w_nd._get())
+        host.bytes_d2h += host.table.nbytes
+        new_leaves, _ = _flatten_state(state)
+        for lv, new in zip(leaves, new_leaves):
+            if lv is not None and new is not None:
+                lv[...] = _np.asarray(new._get())
+
+    def _materialize(self, k, count=True):
+        """Full-table host->device transfer (dense pull of a host key)."""
+        import jax.numpy as jnp
+
+        host = self._store[k]
+        if count:
+            host.bytes_h2d += host.table.nbytes
+        return NDArray._from_jax(jnp.asarray(host.table))
+
+    def _sparse_lazy_update(self, k, host, grad):
+        """Server-side lazy update: run the optimizer's own dense kernels on
+        the touched-row slices, so ONLY those rows (weight + state) move and
+        change — every optimizer gets reference ``lazy_update`` semantics.
+        Reference: kvstore_dist_server.h DataHandleRowSparse applying the
+        sparse FComputeEx updates (SURVEY.md §4.4)."""
+        import jax.numpy as jnp
+
+        rows = _np.asarray(grad._rs_indices).astype(_np.int64)
+        vals = _np.asarray(grad._rs_values)           # D2H: K rows
+        host.bytes_d2h += vals.nbytes
+        if rows.size == 0:
+            return
+        # merge duplicate rows (multi-device reduce may concatenate)
+        uniq, inv = _np.unique(rows, return_inverse=True)
+        if uniq.size != rows.size:
+            merged = _np.zeros((uniq.size,) + vals.shape[1:], vals.dtype)
+            _np.add.at(merged, inv, vals)
+            rows, vals = uniq, merged
+        idx = _key_int(k)
+        w_nd = NDArray._from_jax(jnp.asarray(host.table[rows]))
+        g_nd = NDArray._from_jax(jnp.asarray(vals))
+        host.bytes_h2d += host.table[rows].nbytes + vals.nbytes
+        opt = self._optimizer
+        self._ensure_host_state(k, host, w_nd)
+        leaves, treedef = host.state
+        slice_leaves = [None if lv is None else
+                        NDArray._from_jax(jnp.asarray(lv[rows]))
+                        for lv in leaves]
+        for lv in slice_leaves:
+            if lv is not None:
+                host.bytes_h2d += _np.asarray(lv._get()).nbytes
+        state = _unflatten_state(slice_leaves, treedef)
+        opt.update_multi_precision(idx, w_nd, g_nd, state)
+        new_w = _np.asarray(w_nd._get())              # D2H: K rows back
+        host.table[rows] = new_w
+        host.bytes_d2h += new_w.nbytes
+        new_leaves, _ = _flatten_state(state)
+        for lv, new in zip(leaves, new_leaves):
+            if lv is not None and new is not None:
+                arr = _np.asarray(new._get())
+                lv[rows] = arr
+                host.bytes_d2h += arr.nbytes
 
     # -- optimizer attach ---------------------------------------------------
     def set_optimizer(self, optimizer):
@@ -159,14 +375,36 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("no updater attached")
+        blob = self._updater.get_states(dump_optimizer)
+        host = {k: v.state for k, v in self._store.items()
+                if isinstance(v, _HostRowSparseTable) and v.state is not None}
         with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+            if host:
+                # host-resident row-sparse keys keep their optimizer state
+                # server-side; bundle it alongside the updater blob
+                f.write(pickle.dumps({"__kv_host_states__": host,
+                                      "updater": blob}))
+            else:
+                f.write(blob)
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("no updater attached")
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            data = f.read()
+        try:
+            obj = pickle.loads(data)
+        except Exception:  # pragma: no cover - non-pickle payloads
+            obj = None
+        if isinstance(obj, dict) and "__kv_host_states__" in obj:
+            self._updater.set_states(obj["updater"])
+            self._pending_host_state.update(obj["__kv_host_states__"])
+            for k in list(self._pending_host_state):
+                cur = self._store.get(k)
+                if isinstance(cur, _HostRowSparseTable):
+                    cur.state = self._pending_host_state.pop(k)
+        else:
+            self._updater.set_states(data)
 
     def barrier(self):
         _ndm.waitall()
@@ -423,3 +661,23 @@ def _key_int(k):
         return int(k)
     except (TypeError, ValueError):
         return k
+
+
+def _flatten_state(state):
+    """Flatten an optimizer state (None / NDArray / tuple / list) into
+    (leaves, treedef) so host mirrors can shadow each leaf."""
+    if state is None:
+        return [None], "none"
+    if isinstance(state, (tuple, list)):
+        return list(state), ("seq", isinstance(state, tuple), len(state))
+    return [state], "single"
+
+
+def _unflatten_state(leaves, treedef):
+    if treedef == "none":
+        return None
+    if treedef == "single":
+        return leaves[0]
+    _, is_tuple, n = treedef
+    seq = list(leaves[:n])
+    return tuple(seq) if is_tuple else seq

@@ -250,3 +250,185 @@ def test_sparse_dot_gradient_to_dense_operand():
     loss.backward()
     expect = dense.T @ np.ones((4, 3), "f")
     assert np.allclose(w.grad.asnumpy(), expect, atol=1e-5)
+
+
+def test_row_sparse_pull_bytes_scale_with_touched_rows():
+    """The server-side table is host-resident: a row_sparse_pull of K rows
+    moves O(K*cols) bytes host->device, NOT the table (VERDICT r4 item 4;
+    reference: kvstore_dist_server.h DataHandleRowSparse)."""
+    from mxnet_tpu.kvstore import _HostRowSparseTable
+
+    N, C = 10000, 32
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.array(np.random.RandomState(0).randn(N, C).astype("f")))
+    out = nd.zeros((5, C))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 7, 7, 500, 9999]))
+    host = kv._store["emb"]
+    assert isinstance(host, _HostRowSparseTable)
+    table_bytes = N * C * 4
+    assert host.bytes_h2d == 5 * C * 4, host.bytes_h2d
+    assert host.bytes_h2d < table_bytes // 100
+    # values correct (duplicates allowed, served in row_ids order)
+    assert np.allclose(out.asnumpy(), host.table[[1, 7, 7, 500, 9999]])
+
+
+def test_sparse_lazy_update_server_side_bytes_and_trajectory():
+    """Push of row-sparse grads updates ONLY touched rows server-side via
+    the optimizer's own kernels; bytes moved scale with touched rows, and
+    a multi-step trajectory matches the dense updater oracle exactly on
+    touched rows while untouched rows never change."""
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.kvstore import _HostRowSparseTable
+
+    N, C = 2000, 8
+    R = np.random.RandomState(1)
+    w0 = R.randn(N, C).astype("f")
+
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.array(w0))
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.5, momentum=0.9))
+
+    # dense oracle: same optimizer applied to a full dense weight/grad
+    oracle_w = w0.copy()
+    oracle_mom = np.zeros_like(oracle_w)
+
+    touched = set()
+    for step in range(4):
+        rows = R.choice(N, size=3, replace=False)
+        touched.update(rows.tolist())
+        gv = R.randn(3, C).astype("f")
+        grad = row_sparse_array((gv, rows.astype("i")), shape=(N, C))
+        kv.push("emb", grad)
+        # lazy semantics: only touched rows see momentum decay + update
+        oracle_mom[rows] = 0.9 * oracle_mom[rows] - 0.5 * gv
+        oracle_w[rows] += oracle_mom[rows]
+
+    host = kv._store["emb"]
+    assert isinstance(host, _HostRowSparseTable)
+    # 4 steps x 3 rows x (grad D2H + w/g/mom H2D + w/mom D2H) ~ 6 row-bufs
+    per_row = C * 4
+    assert host.bytes_d2h + host.bytes_h2d <= 4 * 3 * per_row * 8
+    assert host.bytes_d2h + host.bytes_h2d < N * C * 4  # << one table copy
+
+    untouched = [i for i in range(N) if i not in touched][:50]
+    assert np.allclose(host.table[untouched], w0[untouched])
+    rows_l = sorted(touched)
+    np.testing.assert_allclose(host.table[rows_l], oracle_w[rows_l],
+                               rtol=1e-5)
+    # row_sparse_pull returns the updated rows
+    rout = nd.zeros((len(rows_l), C))
+    kv.row_sparse_pull("emb", out=rout, row_ids=nd.array(rows_l))
+    np.testing.assert_allclose(rout.asnumpy(), oracle_w[rows_l], rtol=1e-5)
+    # ...and a dense pull still materializes the full, consistent table
+    full = nd.zeros((N, C))
+    kv.pull("emb", out=full)
+    np.testing.assert_allclose(full.asnumpy()[rows_l], oracle_w[rows_l],
+                               rtol=1e-5)
+
+
+def test_sparse_lazy_update_adam_state_structure():
+    """The host path learns arbitrary optimizer state STRUCTURE (adam's
+    (mean, var) tuple) and keeps full-height host mirrors per leaf."""
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.kvstore import _HostRowSparseTable
+
+    N, C = 64, 4
+    kv = mx.kv.create("local")
+    kv.init("e", nd.zeros((N, C)))
+    kv.set_optimizer(opt.create("adam", learning_rate=0.1))
+    g = row_sparse_array((np.ones((2, C), "f"), [3, 10]), shape=(N, C))
+    kv.push("e", g)
+    kv.push("e", g)
+    host = kv._store["e"]
+    assert isinstance(host, _HostRowSparseTable)
+    leaves, treedef = host.state
+    assert treedef == ("seq", True, 2)
+    assert all(lv.shape == (N, C) for lv in leaves)
+    out = nd.zeros((3, C))
+    kv.row_sparse_pull("e", out=out, row_ids=nd.array([3, 10, 0]))
+    d = out.asnumpy()
+    assert np.all(d[2] == 0.0) and np.all(d[:2] != 0.0)
+    assert np.isfinite(d).all()
+
+
+def test_fm_example_kvstore_mode_matches_local_trajectory():
+    """The FM example trained through the server-side row-sparse kvstore
+    path follows the same loss trajectory as the manual-SGD mode (VERDICT
+    r4 item 4 'done' criterion), while moving only touched-row bytes."""
+    import importlib.util
+    import os
+
+    from mxnet_tpu.kvstore import _HostRowSparseTable
+
+    path = os.path.join(os.path.dirname(__file__), "..", "example",
+                        "sparse", "factorization_machine.py")
+    spec = importlib.util.spec_from_file_location("fm_example", path)
+    fm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fm)
+
+    kw = dict(num_features=400, rank=4, batch_size=32, steps=12, lr=0.5,
+              density=0.02, log_every=0, seed=7)
+    local = fm.run(use_kvstore=False, **kw)
+    kvs = fm.run(use_kvstore=True, **kw)
+    assert len(local) == len(kvs) == 12
+    np.testing.assert_allclose(kvs, local, rtol=2e-3, atol=2e-4)
+
+
+def test_host_sparse_state_survives_dense_transitions_and_saveload():
+    """Momentum accumulated on a host-resident row-sparse key survives
+    (a) a dense-gradient push (in-place full-row update, no state reset),
+    and (b) a save/load_optimizer_states round trip (review findings r5)."""
+    import os
+    import tempfile
+
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.kvstore import _HostRowSparseTable
+
+    N, C = 50, 4
+
+    def oracle(steps):
+        w = np.zeros((N, C), "f")
+        mom = np.zeros((N, C), "f")
+        for kind, rows, gv in steps:
+            if kind == "sparse":
+                mom[rows] = 0.9 * mom[rows] - 0.5 * gv
+                w[rows] += mom[rows]
+            else:
+                mom = 0.9 * mom - 0.5 * gv
+                w += mom
+        return w, mom
+
+    R = np.random.RandomState(3)
+    g1 = R.randn(2, C).astype("f")
+    gd = R.randn(N, C).astype("f")
+    g2 = R.randn(2, C).astype("f")
+    steps = [("sparse", [1, 7], g1), ("dense", None, gd),
+             ("sparse", [1, 7], g2)]
+
+    kv = mx.kv.create("local")
+    kv.init("e", nd.zeros((N, C)))
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.5, momentum=0.9))
+    kv.push("e", row_sparse_array((g1, [1, 7]), shape=(N, C)))
+    host = kv._store["e"]
+    assert isinstance(host, _HostRowSparseTable)
+    # dense push updates in place: same table object, state kept
+    kv.push("e", nd.array(gd))
+    assert kv._store["e"] is host and host.state is not None
+    kv.push("e", row_sparse_array((g2, [1, 7]), shape=(N, C)))
+    w_exp, mom_exp = oracle(steps)
+    np.testing.assert_allclose(host.table, w_exp, rtol=1e-5, atol=1e-6)
+
+    # save/load round trip into a FRESH store: state must carry over
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "opt.states")
+        kv.save_optimizer_states(fname)
+        kv2 = mx.kv.create("local")
+        kv2.init("e", nd.array(host.table.copy()))
+        kv2.set_optimizer(opt.create("sgd", learning_rate=0.5, momentum=0.9))
+        kv2.load_optimizer_states(fname)
+        g3 = R.randn(2, C).astype("f")
+        kv2.push("e", row_sparse_array((g3, [1, 7]), shape=(N, C)))
+        w_exp2, _ = oracle(steps + [("sparse", [1, 7], g3)])
+        host2 = kv2._store["e"]
+        np.testing.assert_allclose(host2.table, w_exp2, rtol=1e-5,
+                                   atol=1e-6)
