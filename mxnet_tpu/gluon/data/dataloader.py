@@ -3,11 +3,15 @@
 Reference: ``python/mxnet/gluon/data/dataloader.py`` (_MultiWorkerIter with
 multiprocessing workers + POSIX-shm zero-copy batches — SURVEY.md §3.4).
 
-TPU-native: worker processes would fight the TPU runtime for the process
-space; the idiomatic host-side pipeline is a thread pool (NumPy decode
-releases the GIL in the hot paths) feeding a device-prefetch queue —
-same shape as the reference's parser→batcher→prefetcher pipeline (§4.5).
-``num_workers`` maps to the thread pool size.
+TPU-native: the default host-side pipeline is a thread pool (NumPy decode
+releases the GIL in the hot paths) feeding a device-prefetch queue — same
+shape as the reference's parser→batcher→prefetcher pipeline (§4.5), and
+threads never fight the TPU runtime for the process space.  For GIL-bound
+user transforms (pure-Python ``transform_fn``s that never release the
+GIL), pass ``thread_pool=False`` to get PROCESS workers — the reference's
+multiprocessing design with pickle transport: workers run dataset[i] +
+batchify to plain numpy (no device runtime in children) and the parent
+converts to NDArray.  ``num_workers`` sizes either pool.
 """
 from __future__ import annotations
 
@@ -20,7 +24,7 @@ import numpy as _np
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
 
-__all__ = ["DataLoader", "default_batchify_fn"]
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
 
 
 def default_batchify_fn(data):
@@ -35,6 +39,45 @@ def default_batchify_fn(data):
         return tuple(default_batchify_fn(list(d)) for d in zip(*data))
     arr = _np.asarray(data)
     return array(arr)
+
+
+def default_mp_batchify_fn(data):
+    """Stack samples into a NUMPY batch — the worker-process batchify
+    (reference: default_mp_batchify_fn building shared-memory NDArrays).
+    Children must not touch the device runtime; the parent converts."""
+    if isinstance(data[0], tuple):
+        return tuple(default_mp_batchify_fn(list(d)) for d in zip(*data))
+    if hasattr(data[0], "asnumpy"):
+        return _np.stack([d.asnumpy() for d in data])
+    return _np.asarray(data)
+
+
+_worker_dataset = None
+
+
+def _worker_initializer(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+class _WorkerFn:
+    """Picklable per-batch task: dataset[i] for the batch + batchify."""
+
+    def __init__(self, batchify_fn):
+        self._fn = batchify_fn
+
+    def __call__(self, batch):
+        return self._fn([_worker_dataset[i] for i in batch])
+
+
+def _to_nd(out):
+    from ...ndarray.ndarray import array
+
+    if isinstance(out, tuple):
+        return tuple(_to_nd(o) for o in out)
+    if isinstance(out, _np.ndarray):
+        return array(out)
+    return out
 
 
 class DataLoader:
@@ -55,6 +98,7 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
+        self._thread_pool = thread_pool
         self._prefetch = max(0, prefetch or 2 * max(num_workers, 1))
 
     def __len__(self):
@@ -65,7 +109,59 @@ class DataLoader:
             for batch in self._batch_sampler:
                 yield self._batchify_fn([self._dataset[i] for i in batch])
             return
-        yield from self._threaded_iter()
+        if self._thread_pool:
+            yield from self._threaded_iter()
+        else:
+            yield from self._process_iter()
+
+    def _process_iter(self):
+        """Process workers for GIL-bound transforms (reference:
+        _MultiWorkerIter).  Workers produce numpy batches (pickle
+        transport); the parent converts to NDArray.
+
+        Children must not touch the parent's device runtime: workers are
+        created with JAX_PLATFORMS=cpu in the environment, so a dataset
+        that dispatches an NDArray op (or asnumpy) in a child initializes
+        at most a CPU backend — never a second TPU client (the axon tunnel
+        is single-client).  Start method defaults to fork (fast; same
+        caveat as the reference's multiprocessing loader); set
+        MXNET_MP_START_METHOD=spawn for a clean-slate child at higher
+        startup cost."""
+        import multiprocessing as mp
+        import os
+
+        fn = self._batchify_fn
+        if fn is default_batchify_fn:
+            fn = default_mp_batchify_fn
+        ctx = mp.get_context(os.environ.get("MXNET_MP_START_METHOD",
+                                            "fork"))
+        prev = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            pool = ctx.Pool(self._num_workers,
+                            initializer=_worker_initializer,
+                            initargs=(self._dataset,))
+        finally:
+            if prev is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = prev
+        # bound in-flight work: imap's feeder thread would otherwise
+        # enqueue the whole epoch and buffer every finished batch
+        sem = threading.BoundedSemaphore(self._num_workers + self._prefetch)
+
+        def gated():
+            for b in self._batch_sampler:
+                sem.acquire()
+                yield b
+
+        try:
+            for out in pool.imap(_WorkerFn(fn), gated()):
+                sem.release()
+                yield _to_nd(out)
+        finally:
+            pool.terminate()
+            pool.join()
 
     def _threaded_iter(self):
         pool = ThreadPoolExecutor(max_workers=self._num_workers)

@@ -151,37 +151,12 @@ class LlamaMoEMLP(HybridBlock):
                                              shape=(E, I, H))
 
     def hybrid_forward(self, F, x, router, gate_proj, up_proj, down_proj):
-        from ....ndarray.ndarray import apply_fn
-        from ....parallel.expert_parallel import moe_apply
-
+        # a registered op (not a raw apply_fn), so the block traces to
+        # Symbol and exports/imports like the rest of the zoo
         cfg = self._cfg
-
-        def expert_fn(p, toks):
-            import jax
-
-            g = toks @ p["g"]
-            u = toks @ p["u"]
-            return (jax.nn.silu(g) * u) @ p["d"]
-
-        def pure(xv, rv, gv, uv, dv):
-            from ....parallel.expert_parallel import inject_aux_loss
-
-            b, l, h = xv.shape
-            toks = xv.reshape(-1, h)
-            out, aux = moe_apply(
-                expert_fn, {"g": gv, "u": uv, "d": dv}, rv, toks,
-                capacity_factor=cfg.moe_capacity_factor)
-            out = out.reshape(b, l, h)
-            if cfg.moe_aux_loss_weight:
-                # router balance term rides the backward pass (Switch
-                # eq. 4); without it routing collapses onto few experts
-                out = inject_aux_loss(
-                    out, cfg.moe_aux_loss_weight
-                    * aux["load_balance_loss"].astype(out.dtype))
-            return out
-
-        return apply_fn(pure, [x, router, gate_proj, up_proj, down_proj],
-                        name="llama_moe_mlp")
+        return F.moe_swiglu(x, router, gate_proj, up_proj, down_proj,
+                            capacity_factor=cfg.moe_capacity_factor,
+                            aux_loss_weight=cfg.moe_aux_loss_weight)
 
 
 class LlamaDecoderLayer(HybridBlock):

@@ -45,11 +45,13 @@ class _Conv(HybridBlock):
                     (in_channels // groups,)
             else:
                 wshape = (channels, in_channels // groups) + tuple(kernel_size)
-        else:  # Deconvolution: (in_channels, channels//groups, *k)
+        else:  # Deconvolution: (in, out/g, *k); channel-last (in, *k, out/g)
             if self._channel_last:
-                raise ValueError("Deconvolution supports channel-first "
-                                 "layouts only (NCW/NCHW/NCDHW)")
-            wshape = (in_channels, channels // groups) + tuple(kernel_size)
+                wshape = (in_channels,) + tuple(kernel_size) + \
+                    (channels // groups,)
+            else:
+                wshape = (in_channels, channels // groups) + \
+                    tuple(kernel_size)
         self.weight = self.params.get("weight", shape=wshape,
                                       init=weight_initializer,
                                       allow_deferred_init=True)
@@ -66,10 +68,14 @@ class _Conv(HybridBlock):
     def infer_shape(self, x, *args):
         g = self._kwargs["num_group"]
         w = list(self.weight.shape)
-        if self._channel_last:
-            self.weight.shape = tuple(w[:-1]) + (x.shape[-1] // g,)
-        elif self._op_name == "Convolution":
-            self.weight.shape = (w[0], x.shape[1] // g) + tuple(w[2:])
+        if self._op_name == "Convolution":
+            if self._channel_last:
+                self.weight.shape = tuple(w[:-1]) + (x.shape[-1] // g,)
+            else:
+                self.weight.shape = (w[0], x.shape[1] // g) + tuple(w[2:])
+        elif self._channel_last:  # Deconvolution, (in, *k, out/g)
+            self.weight.shape = (x.shape[-1],) + tuple(w[1:-1]) + \
+                (self._channels // g,)
         else:
             self.weight.shape = (x.shape[1], self._channels // g) + tuple(w[2:])
 

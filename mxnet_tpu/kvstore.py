@@ -58,6 +58,7 @@ class _HostRowSparseTable:
     def __init__(self, dense_np):
         self.table = _np.array(dense_np)      # full table, host memory
         self.state = None                     # host optimizer-state leaves
+        self.sparse_pushes = 0
         self.bytes_h2d = 0
         self.bytes_d2h = 0
 
@@ -109,7 +110,10 @@ class KVStore:
                     and self._updater is not None
                     and self._optimizer is not None
                     and self._compression is None
-                    and getattr(self._optimizer, "lazy_update", True)
+                    # only optimizers that DECLARE lazy semantics (sgd,
+                    # adagrad, adam set lazy_update) take the host lazy
+                    # path; others keep the densify-and-update fallback
+                    and getattr(self._optimizer, "lazy_update", False)
                     and not getattr(self, "_sharded_update", False)):
                 host = self._ensure_host_table(k)
                 if host is not None:
@@ -121,14 +125,18 @@ class KVStore:
                         and self._optimizer is not None
                         and self._compression is None
                         and not isinstance(reduced, RowSparseNDArray)
+                        and host.sparse_pushes > 0
                         and not getattr(self, "_sharded_update", False)):
-                    # dense gradient on a host-resident key: apply the
-                    # optimizer over all rows in place — no demote, so the
+                    # dense gradient on a MIXED-workload host key: apply
+                    # the optimizer over all rows in place — no demote, so
                     # host state survives sparse<->dense transitions
                     self._host_dense_update(k, host, reduced)
                     continue
-                # no updater (or compression/sharded): demote, handing any
-                # accumulated host state back to the updater
+                # purely-dense traffic (key was only promoted by a
+                # row_sparse_pull), no updater, compression, or sharded:
+                # demote back to the device-resident path, handing any
+                # accumulated host state to the updater — dense training
+                # must not pay full-table host round trips per step
                 self._store[k] = self._demote(k)
             if self._compression is not None:
                 reduced = self._compression.round_trip(reduced, key=k)
@@ -321,9 +329,11 @@ class KVStore:
             _np.add.at(merged, inv, vals)
             rows, vals = uniq, merged
         idx = _key_int(k)
-        w_nd = NDArray._from_jax(jnp.asarray(host.table[rows]))
+        host.sparse_pushes += 1
+        w_rows = host.table[rows]
+        w_nd = NDArray._from_jax(jnp.asarray(w_rows))
         g_nd = NDArray._from_jax(jnp.asarray(vals))
-        host.bytes_h2d += host.table[rows].nbytes + vals.nbytes
+        host.bytes_h2d += w_rows.nbytes + vals.nbytes
         opt = self._optimizer
         self._ensure_host_state(k, host, w_nd)
         leaves, treedef = host.state

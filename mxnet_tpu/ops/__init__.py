@@ -10,4 +10,5 @@ from . import detection_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import flash_attention  # noqa: F401
 from . import quantization_ops  # noqa: F401
+from . import legacy_ops  # noqa: F401
 from .registry import OP_TABLE, get_op, list_ops, register  # noqa: F401

@@ -125,3 +125,39 @@ def swiglu(gate, up):
     from jax import nn
 
     return nn.silu(gate) * up
+
+
+@register("_contrib_moe_swiglu", aliases=("moe_swiglu",))
+def moe_swiglu(x, router_weight, gate_proj, up_proj, down_proj,
+               capacity_factor=1.25, aux_loss_weight=0.0):
+    """Switch-MoE SwiGLU FFN over stacked expert weights (Mixtral-style;
+    net-new vs the reference).  Registered as a first-class op so MoE
+    models trace to Symbol and export/SymbolBlock-import like any other
+    graph (fused RNN set the precedent for stateful library ops).
+
+    x (B, L, H); router (H, E); gate/up (E, H, I); down (E, I, H).
+    The aux load-balance loss rides the backward pass via inject_aux_loss
+    when aux_loss_weight > 0 (Switch Transformer eq. 4)."""
+    from ..parallel.expert_parallel import inject_aux_loss, moe_apply
+
+    capacity_factor = float(capacity_factor)
+    aux_loss_weight = float(aux_loss_weight)
+
+    def expert_fn(p, toks):
+        from jax import nn
+
+        return (nn.silu(toks @ p["g"]) * (toks @ p["u"])) @ p["d"]
+
+    b, l, h = x.shape
+    toks = x.reshape(-1, h)
+    out, aux = moe_apply(
+        expert_fn, {"g": gate_proj, "u": up_proj, "d": down_proj},
+        router_weight, toks, capacity_factor=capacity_factor)
+    out = out.reshape(b, l, h)
+    if aux_loss_weight:
+        # router balance term rides the backward pass; without it routing
+        # collapses onto few experts
+        out = inject_aux_loss(
+            out, aux_loss_weight
+            * aux["load_balance_loss"].astype(out.dtype))
+    return out

@@ -1,0 +1,117 @@
+"""Legacy standalone ops: Correlation and SVMOutput.
+
+Reference: ``src/operator/correlation.cc`` (the FlowNet correlation layer)
+and ``src/operator/svm_output.cc`` (SURVEY.md §3.2 legacy rows).
+
+TPU-first: Correlation is expressed as a displacement-stacked elementwise
+product + box reduce_window — dense, static-shaped, fully XLA-fusable (the
+CUDA original hand-tiles shared memory; the MXU/VPU path needs none of
+that).  SVMOutput pins its loss gradient with jax.custom_vjp exactly like
+SoftmaxOutput.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register("Correlation", aliases=("correlation",))
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation (reference: correlation.cc CorrelationForward).
+
+    data1/data2 (N, C, H, W) -> (N, D*D, H_out, W_out) with
+    D = 2*floor(max_displacement/stride2)+1; each output channel is the
+    patch correlation (or abs-difference) between data1 and data2 shifted
+    by one displacement, averaged over kernel patch and channels."""
+    import jax
+
+    jnp = _jnp()
+    k = int(kernel_size)
+    if k % 2 == 0:
+        # the reference's kernel_radius = (k-1)/2 centering math (and this
+        # box-sum lowering) is only well-defined for odd patches
+        raise ValueError("Correlation requires an odd kernel_size")
+    md = int(max_displacement)
+    s1, s2 = int(stride1), int(stride2)
+    pad = int(pad_size)
+
+    n, c, h, w = data1.shape
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    kr = (k - 1) // 2                      # kernel radius
+    border = md + kr
+    out_h = int(_np.ceil((ph - border * 2) / float(s1)))
+    out_w = int(_np.ceil((pw - border * 2) / float(s1)))
+    if out_h < 1 or out_w < 1:
+        raise ValueError("Correlation: output would be empty; grow "
+                         "pad_size or shrink max_displacement")
+    grid = md // s2
+    shifts = [(dy, dx) for dy in range(-grid * s2, grid * s2 + 1, s2)
+              for dx in range(-grid * s2, grid * s2 + 1, s2)]
+    sumelems = float(k * k * c)
+
+    def one_shift(shift):
+        dy, dx = shift
+        shifted = jnp.roll(d2, (-dy, -dx), axis=(2, 3))
+        prod = d1 * shifted if is_multiply else -jnp.abs(d1 - shifted)
+        red = jnp.sum(prod, axis=1)                      # (N, ph, pw)
+        # box-sum over the kernel patch
+        win = jax.lax.reduce_window(
+            red, 0.0, jax.lax.add, (1, k, k), (1, 1, 1),
+            [(0, 0), (kr, kr), (kr, kr)])
+        # sample output positions: start at border, step stride1
+        ys = border + s1 * jnp.arange(out_h)
+        xs = border + s1 * jnp.arange(out_w)
+        return win[:, ys][:, :, xs] / sumelems           # (N, oh, ow)
+
+    maps = [one_shift(sh) for sh in shifts]
+    return jnp.stack(maps, axis=1)                       # (N, D*D, oh, ow)
+
+
+@register("SVMOutput", aliases=("svm_output",))
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Forward = identity on data; backward = the multiclass hinge-loss
+    gradient, *ignoring* the incoming out_grad — a loss layer exactly like
+    the reference (src/operator/svm_output.cc L1/L2-SVM kernels)."""
+    import jax
+
+    jnp = _jnp()
+    margin = float(margin)
+    reg = float(regularization_coefficient)
+
+    @jax.custom_vjp
+    def _svm(d, l):
+        return d
+
+    def _fwd(d, l):
+        return d, (d, l)
+
+    def _bwd(res, g):
+        d, l = res
+        li = l.astype(_np.int32)
+        ncls = d.shape[-1]
+        onehot = jax.nn.one_hot(li, ncls, dtype=d.dtype)
+        score_y = jnp.take_along_axis(d, li[..., None], axis=-1)
+        viol = margin - (score_y - d)      # margin violation per class
+        if use_linear:                     # L1-SVM: subgradient of hinge
+            mask = (viol > 0).astype(d.dtype) * (1.0 - onehot)
+            grad = reg * (mask - onehot * jnp.sum(mask, axis=-1,
+                                                  keepdims=True))
+        else:                              # L2-SVM: grad of squared hinge
+            act = jnp.maximum(viol, 0.0) * (1.0 - onehot)
+            grad = 2.0 * reg * (act - onehot * jnp.sum(act, axis=-1,
+                                                       keepdims=True))
+        return grad, jnp.zeros_like(l)
+
+    _svm.defvjp(_fwd, _bwd)
+    return _svm(data, label)

@@ -112,7 +112,8 @@ def deconvolution(x, weight, *maybe_bias, kernel=None, stride=None, dilate=None,
                   no_bias=True, layout=None, target_shape=None, workspace=None,
                   cudnn_tune=None, cudnn_off=None):
     """Transposed convolution (reference: src/operator/nn/deconvolution.cc —
-    the gradient of Convolution wrt its input, weight layout (in, out/g, *k)).
+    the gradient of Convolution wrt its input; weight layout
+    (in, out/g, *k) channel-first, (in, *k, out/g) for NWC/NHWC/NDHWC).
 
     Lowered directly as a conv_general_dilated with lhs_dilation=strides on
     the spatially-flipped kernel — the exact gradient program, so XLA:TPU
@@ -120,34 +121,53 @@ def deconvolution(x, weight, *maybe_bias, kernel=None, stride=None, dilate=None,
     lax = _lax()
     jnp = _jnp()
     nd = x.ndim - 2
+    cl = layout is not None and layout.endswith("C")  # channel-last
     strides = _tup(stride, nd)
     pads = _tup(pad, nd) if pad is not None else (0,) * nd
     dil = _tup(dilate, nd)
     adjs = _tup(adj, nd) if adj is not None else (0,) * nd
-    dn_str = {3: ("NCH", "IOH", "NCH"), 4: ("NCHW", "IOHW", "NCHW"),
-              5: ("NCDHW", "IODHW", "NCDHW")}[x.ndim]
-    w_flip = jnp.flip(weight, axis=tuple(range(2, weight.ndim)))
+    if cl:
+        dn_str = {3: ("NHC", "IHO", "NHC"), 4: ("NHWC", "IHWO", "NHWC"),
+                  5: ("NDHWC", "IDHWO", "NDHWC")}[x.ndim]
+        sp_axes = tuple(range(1, weight.ndim - 1))
+        k_shape = weight.shape[1:-1]
+    else:
+        dn_str = {3: ("NCH", "IOH", "NCH"), 4: ("NCHW", "IOHW", "NCHW"),
+                  5: ("NCDHW", "IODHW", "NCDHW")}[x.ndim]
+        sp_axes = tuple(range(2, weight.ndim))
+        k_shape = weight.shape[2:]
+    w_flip = jnp.flip(weight, axis=sp_axes)
     padding = [(d * (k - 1) - p, d * (k - 1) - p + a)
-               for k, p, d, a in zip(weight.shape[2:], pads, dil, adjs)]
+               for k, p, d, a in zip(k_shape, pads, dil, adjs)]
 
     if num_group > 1:
-        # one grouped conv instead of a per-group python loop: reorder
-        # (in, out/g, *k) -> (in/g, out, *k) so XLA's native grouped-conv
-        # kernel handles the partitioning (group gi of the lhs channels
-        # maps to output block gi, matching the reference's layout)
+        # one grouped conv instead of a per-group python loop: reorder so
+        # XLA's native grouped-conv kernel handles the partitioning (group
+        # gi of the lhs channels maps to output block gi, matching the
+        # reference's layout)
         g = num_group
         cin_g = w_flip.shape[0] // g
-        og = w_flip.shape[1]
-        w_flip = w_flip.reshape((g, cin_g, og) + w_flip.shape[2:])
-        w_flip = jnp.swapaxes(w_flip, 0, 1)
-        w_flip = w_flip.reshape((cin_g, g * og) + w_flip.shape[3:])
+        if cl:
+            # (in, *k, out/g) -> (in/g, *k, g*out/g)
+            og = w_flip.shape[-1]
+            sp = w_flip.shape[1:-1]
+            w_flip = w_flip.reshape((g, cin_g) + sp + (og,))
+            w_flip = jnp.moveaxis(w_flip, 0, -2)
+            w_flip = w_flip.reshape((cin_g,) + sp + (g * og,))
+        else:
+            # (in, out/g, *k) -> (in/g, g*out/g, *k)
+            og = w_flip.shape[1]
+            w_flip = w_flip.reshape((g, cin_g, og) + w_flip.shape[2:])
+            w_flip = jnp.swapaxes(w_flip, 0, 1)
+            w_flip = w_flip.reshape((cin_g, g * og) + w_flip.shape[3:])
     dn = lax.conv_dimension_numbers(x.shape, w_flip.shape, dn_str)
     y = lax.conv_general_dilated(
         x, w_flip, window_strides=(1,) * nd, padding=padding,
         lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
         feature_group_count=num_group)
     if not no_bias and maybe_bias:
-        y = y + maybe_bias[0].reshape((1, -1) + (1,) * nd)
+        b = maybe_bias[0]
+        y = y + b if cl else y + b.reshape((1, -1) + (1,) * nd)
     return y
 
 
@@ -630,14 +650,21 @@ def rnn_param_size(mode, input_size, state_size, num_layers=1,
 
 
 def _rnn_scan_dir(jnp, mode, xs, h0, c0, wi, wh, bi, bh,
-                  clip_min=None, clip_max=None, clip_nan=False):
-    """xs (T, N, ni) -> (hs (T, N, nh), h_final, c_final|None)."""
+                  clip_min=None, clip_max=None, clip_nan=False,
+                  seq_len=None):
+    """xs (T, N, ni) -> (hs (T, N, nh), h_final, c_final|None).
+
+    With ``seq_len`` (N,) int32, steps at t >= seq_len[n] neither advance
+    the carry nor emit output for sample n (reference: rnn.cc
+    use_sequence_length masking): the final state is the state at each
+    sample's last valid step and padded outputs are zero."""
     import jax
     from jax import nn as jnn
 
     i2h_all = jnp.einsum("tni,gi->tng", xs, wi) + bi
-    if mode == "lstm":
-        def step(carry, i2h_t):
+    lstm = mode == "lstm"
+    if lstm:
+        def core(carry, i2h_t):
             h_prev, c_prev = carry
             gates = i2h_t + h_prev @ wh.T + bh
             i, f, g_, o = jnp.split(gates, 4, axis=-1)
@@ -652,10 +679,9 @@ def _rnn_scan_dir(jnp, mode, xs, h0, c0, wi, wh, bi, bh,
             h = o * jnp.tanh(c)
             return (h, c), h
 
-        (hf, cf), hs = jax.lax.scan(step, (h0, c0), i2h_all)
-        return hs, hf, cf
-    if mode == "gru":
-        def step(h_prev, i2h_t):
+        carry0 = (h0, c0)
+    elif mode == "gru":
+        def core(h_prev, i2h_t):
             h2h = h_prev @ wh.T + bh
             ir, iz, in_ = jnp.split(i2h_t, 3, axis=-1)
             hr, hz, hn = jnp.split(h2h, 3, axis=-1)
@@ -665,16 +691,46 @@ def _rnn_scan_dir(jnp, mode, xs, h0, c0, wi, wh, bi, bh,
             h = (1 - z) * n + z * h_prev
             return h, h
 
-        hf, hs = jax.lax.scan(step, h0, i2h_all)
-        return hs, hf, None
-    act = (lambda v: jnp.maximum(v, 0)) if mode == "rnn_relu" else jnp.tanh
+        carry0 = h0
+    else:
+        act = (lambda v: jnp.maximum(v, 0)) if mode == "rnn_relu" \
+            else jnp.tanh
 
-    def step(h_prev, i2h_t):
-        h = act(i2h_t + h_prev @ wh.T + bh)
-        return h, h
+        def core(h_prev, i2h_t):
+            h = act(i2h_t + h_prev @ wh.T + bh)
+            return h, h
 
-    hf, hs = jax.lax.scan(step, h0, i2h_all)
-    return hs, hf, None
+        carry0 = h0
+
+    if seq_len is None:
+        cf_, hs = jax.lax.scan(core, carry0, i2h_all)
+    else:
+        def step(carry, inp):
+            i2h_t, t = inp
+            new_carry, h = core(carry, i2h_t)
+            m = (t < seq_len).astype(xs.dtype)[:, None]
+            if lstm:
+                new_carry = (m * new_carry[0] + (1 - m) * carry[0],
+                             m * new_carry[1] + (1 - m) * carry[1])
+            else:
+                new_carry = m * new_carry + (1 - m) * carry
+            return new_carry, h * m
+
+        cf_, hs = jax.lax.scan(step, carry0,
+                               (i2h_all, jnp.arange(xs.shape[0])))
+    if lstm:
+        return hs, cf_[0], cf_[1]
+    return hs, cf_, None
+
+
+def _reverse_sequence(jnp, x, seq_len):
+    """Reverse each sample's valid prefix along axis 0, leaving padding in
+    place (reference: sequence_reverse.cc with sequence_length)."""
+    T = x.shape[0]
+    t = jnp.arange(T)[:, None]                       # (T, 1)
+    idx = jnp.where(t < seq_len[None, :], seq_len[None, :] - 1 - t, t)
+    return jnp.take_along_axis(x, idx.reshape(idx.shape + (1,) *
+                                              (x.ndim - 2)), axis=0)
 
 
 @register("RNN", aliases=("rnn",), nout="dynamic", needs_rng=True)
@@ -685,14 +741,15 @@ def fused_rnn(rng_key, data, parameters, *maybe_states, state_size=None,
               lstm_state_clip_nan=False, use_sequence_length=False):
     """data (T, N, C) [the reference's TNC layout], parameters: the flat
     vector (see rnn_param_size), optional state (nl*nd, N, nh) and, for
-    lstm, state_cell.  Returns out, or (out, state_h[, state_cell]) when
-    state_outputs.  Dropout p applies between layers when training."""
+    lstm, state_cell.  With ``use_sequence_length`` the LAST input is
+    sequence_length (N,): steps past each sample's length neither advance
+    states nor emit output, and the reverse direction runs over each
+    sample's valid prefix (reference: rnn.cc use_sequence_length).
+    Returns out, or (out, state_h[, state_cell]) when state_outputs.
+    Dropout p applies between layers when training."""
     jnp = _jnp()
     if projection_size:
         raise ValueError("RNN projection_size is not supported")
-    if use_sequence_length:
-        raise ValueError("RNN use_sequence_length is not supported; mask "
-                         "with SequenceMask or pad to full length")
     T, N, C = data.shape
     nh, nl = int(state_size), int(num_layers)
     ndir = 2 if bidirectional else 1
@@ -713,6 +770,12 @@ def fused_rnn(rng_key, data, parameters, *maybe_states, state_size=None,
     weights = pieces[:n_w]
     biases = pieces[n_w:]
     states = list(maybe_states)
+    seq_len = None
+    if use_sequence_length:
+        if not states:
+            raise ValueError("RNN use_sequence_length=True requires a "
+                             "sequence_length input")
+        seq_len = jnp.asarray(states.pop()).astype(jnp.int32)
     h_all = states[0] if states else jnp.zeros((nl * ndir, N, nh), data.dtype)
     c_all = states[1] if mode == "lstm" and len(states) > 1 else \
         jnp.zeros((nl * ndir, N, nh), data.dtype)
@@ -724,14 +787,21 @@ def fused_rnn(rng_key, data, parameters, *maybe_states, state_size=None,
             idx = layer * ndir + d
             wi, wh = weights[2 * idx], weights[2 * idx + 1]
             bi, bh = biases[2 * idx], biases[2 * idx + 1]
-            seq = out if d == 0 else jnp.flip(out, axis=0)
+            if d == 0:
+                seq = out
+            elif seq_len is None:
+                seq = jnp.flip(out, axis=0)
+            else:
+                seq = _reverse_sequence(jnp, out, seq_len)
             hs, hf, cf = _rnn_scan_dir(jnp, mode, seq, h_all[idx],
                                        c_all[idx], wi, wh, bi, bh,
                                        clip_min=lstm_state_clip_min,
                                        clip_max=lstm_state_clip_max,
-                                       clip_nan=lstm_state_clip_nan)
+                                       clip_nan=lstm_state_clip_nan,
+                                       seq_len=seq_len)
             if d == 1:
-                hs = jnp.flip(hs, axis=0)
+                hs = jnp.flip(hs, axis=0) if seq_len is None else \
+                    _reverse_sequence(jnp, hs, seq_len)
             layer_outs.append(hs)
             out_h.append(hf)
             if cf is not None:

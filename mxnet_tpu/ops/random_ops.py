@@ -113,3 +113,111 @@ def normal_like(key, data, loc=0.0, scale=1.0):
 @register("bernoulli", creation=True, needs_rng=True, differentiable=False)
 def bernoulli(key, prob=0.5, shape=None, dtype="float32"):
     return _jr().bernoulli(key, prob, tuple(shape)).astype(_dt(dtype))
+
+
+# ==========================================================================
+# Probability-density ops (reference: src/operator/random/pdf_op.cc —
+# _random_pdf_*).  sample has one trailing draw axis over broadcast param
+# shapes; fully differentiable wrt sample AND parameters (the reference
+# hand-codes those gradients; jax derives them from the closed forms).
+# ==========================================================================
+def _pdf_out(logp, is_log):
+    jnp = _jnp()
+
+    return logp if is_log else jnp.exp(logp)
+
+
+def _plog(x):
+    """log with -inf-safe gradient at the support boundary."""
+    jnp = _jnp()
+
+    return jnp.log(jnp.maximum(x, 1e-30))
+
+
+@register("_random_pdf_uniform", aliases=("random_pdf_uniform",))
+def pdf_uniform(sample, low, high, is_log=False):
+    jnp = _jnp()
+
+    lo, hi = low[..., None], high[..., None]
+    inside = (sample >= lo) & (sample <= hi)
+    logp = jnp.where(inside, -_plog(hi - lo), -jnp.inf)
+    return _pdf_out(logp, is_log)
+
+
+@register("_random_pdf_normal", aliases=("random_pdf_normal",))
+def pdf_normal(sample, mu, sigma, is_log=False):
+    jnp = _jnp()
+
+    m, s = mu[..., None], sigma[..., None]
+    logp = (-0.5 * ((sample - m) / s) ** 2 - _plog(s)
+            - 0.5 * _np.log(2 * _np.pi))
+    return _pdf_out(logp, is_log)
+
+
+@register("_random_pdf_gamma", aliases=("random_pdf_gamma",))
+def pdf_gamma(sample, alpha, beta, is_log=False):
+    """alpha: shape, beta: rate (reference pdf_op.cc gamma parameterization:
+    p(x) = beta^alpha x^(alpha-1) e^(-beta x) / Gamma(alpha))."""
+    from jax.scipy.special import gammaln
+
+    a, b = alpha[..., None], beta[..., None]
+    logp = (a * _plog(b) + (a - 1) * _plog(sample) - b * sample
+            - gammaln(a))
+    return _pdf_out(logp, is_log)
+
+
+@register("_random_pdf_exponential", aliases=("random_pdf_exponential",))
+def pdf_exponential(sample, lam, is_log=False):
+    lamb = lam[..., None]
+    logp = _plog(lamb) - lamb * sample
+    return _pdf_out(logp, is_log)
+
+
+@register("_random_pdf_poisson", aliases=("random_pdf_poisson",))
+def pdf_poisson(sample, lam, is_log=False):
+    from jax.scipy.special import gammaln
+
+    lamb = lam[..., None]
+    logp = sample * _plog(lamb) - lamb - gammaln(sample + 1.0)
+    return _pdf_out(logp, is_log)
+
+
+@register("_random_pdf_negative_binomial",
+          aliases=("random_pdf_negative_binomial",))
+def pdf_negative_binomial(sample, k, p, is_log=False):
+    """P(x) = C(x+k-1, x) p^k (1-p)^x (reference parameterization: k
+    failures, success probability p)."""
+    from jax.scipy.special import gammaln
+
+    kk, pp = k[..., None], p[..., None]
+    logp = (gammaln(sample + kk) - gammaln(sample + 1.0) - gammaln(kk)
+            + kk * _plog(pp) + sample * _plog(1.0 - pp))
+    return _pdf_out(logp, is_log)
+
+
+@register("_random_pdf_generalized_negative_binomial",
+          aliases=("random_pdf_generalized_negative_binomial",))
+def pdf_generalized_negative_binomial(sample, mu, alpha, is_log=False):
+    """Polya (gamma-Poisson mixture) pdf over mean mu and dispersion alpha
+    (reference: PDF_GeneralizedNegativeBinomial)."""
+    from jax.scipy.special import gammaln
+
+    m, a = mu[..., None], alpha[..., None]
+    r = 1.0 / a
+    logp = (gammaln(sample + r) - gammaln(sample + 1.0) - gammaln(r)
+            + r * _plog(r / (r + m)) + sample * _plog(m / (r + m)))
+    return _pdf_out(logp, is_log)
+
+
+@register("_random_pdf_dirichlet", aliases=("random_pdf_dirichlet",))
+def pdf_dirichlet(sample, alpha, is_log=False):
+    """sample (..., n, k) over alpha (..., k): the trailing draw axis is
+    second-to-last, each draw a k-simplex point (reference pdf_op.cc)."""
+    from jax.scipy.special import gammaln
+
+    jnp = _jnp()
+    a = alpha[..., None, :]
+    logp = (jnp.sum((a - 1.0) * _plog(sample), axis=-1)
+            + gammaln(jnp.sum(a, axis=-1))
+            - jnp.sum(gammaln(a), axis=-1))
+    return _pdf_out(logp, is_log)

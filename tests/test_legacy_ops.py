@@ -1,0 +1,182 @@
+"""Correlation / SVMOutput / pdf_* ops (reference:
+tests/python/unittest/test_operator.py correlation + svm blocks,
+test_random.py pdf tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _corr_oracle(d1, d2, k, md, s1, s2, pad, is_multiply=True):
+    n, c, h, w = d1.shape
+    d1p = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d2p = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    kr = (k - 1) // 2
+    border = md + kr
+    oh = int(np.ceil((ph - 2 * border) / s1))
+    ow = int(np.ceil((pw - 2 * border) / s1))
+    grid = md // s2
+    disp = [(dy, dx) for dy in range(-grid * s2, grid * s2 + 1, s2)
+            for dx in range(-grid * s2, grid * s2 + 1, s2)]
+    out = np.zeros((n, len(disp), oh, ow), "f")
+    for di, (dy, dx) in enumerate(disp):
+        for yo in range(oh):
+            for xo in range(ow):
+                y1, x1 = border + yo * s1, border + xo * s1
+                p1 = d1p[:, :, y1 - kr:y1 + kr + 1, x1 - kr:x1 + kr + 1]
+                p2 = d2p[:, :, y1 + dy - kr:y1 + dy + kr + 1,
+                         x1 + dx - kr:x1 + dx + kr + 1]
+                v = p1 * p2 if is_multiply else -np.abs(p1 - p2)
+                out[:, di, yo, xo] = v.sum(axis=(1, 2, 3)) / (k * k * c)
+    return out
+
+
+@pytest.mark.parametrize("k,md,s1,s2,pad,mult", [
+    (1, 1, 1, 1, 1, True),
+    (3, 2, 2, 1, 3, True),
+    (1, 2, 1, 2, 2, False),
+])
+def test_correlation_matches_oracle(k, md, s1, s2, pad, mult):
+    rs = np.random.RandomState(0)
+    d1 = rs.randn(2, 3, 8, 9).astype("f")
+    d2 = rs.randn(2, 3, 8, 9).astype("f")
+    out = nd.Correlation(nd.array(d1), nd.array(d2), kernel_size=k,
+                         max_displacement=md, stride1=s1, stride2=s2,
+                         pad_size=pad, is_multiply=mult).asnumpy()
+    ref = _corr_oracle(d1, d2, k, md, s1, s2, pad, mult)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_gradients_flow():
+    rs = np.random.RandomState(1)
+    a = nd.array(rs.randn(1, 2, 6, 6).astype("f"))
+    b = nd.array(rs.randn(1, 2, 6, 6).astype("f"))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = nd.Correlation(a, b, kernel_size=1, max_displacement=1,
+                           pad_size=1)
+        loss = (y * y).sum()
+    loss.backward()
+    assert np.isfinite(a.grad.asnumpy()).all()
+    assert np.abs(b.grad.asnumpy()).sum() > 0
+
+
+def test_svm_output_forward_identity_and_l2_grad():
+    """Forward copies scores; backward is the (squared-)hinge gradient
+    ignoring out_grad (reference: svm_output.cc)."""
+    scores = np.array([[2.0, 1.0, -0.5], [0.0, 0.3, 0.2]], "f")
+    label = np.array([0, 2], "f")
+    x = nd.array(scores)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.SVMOutput(x, nd.array(label), margin=1.0,
+                         regularization_coefficient=0.5)
+        # arbitrary downstream scale must be IGNORED by the loss gradient
+        z = (y * 7.0).sum()
+    z.backward()
+    assert np.allclose(y.asnumpy(), scores)
+    # manual L2-SVM gradient
+    g = np.zeros_like(scores)
+    for i, yi in enumerate(label.astype(int)):
+        for j in range(3):
+            if j == yi:
+                continue
+            v = max(0.0, 1.0 - (scores[i, yi] - scores[i, j]))
+            g[i, j] = 2 * 0.5 * v
+            g[i, yi] -= 2 * 0.5 * v
+    np.testing.assert_allclose(x.grad.asnumpy(), g, rtol=1e-5, atol=1e-6)
+
+
+def test_svm_output_l1_variant():
+    scores = np.array([[0.2, 0.9]], "f")
+    x = nd.array(scores)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.SVMOutput(x, nd.array([0.0]), margin=1.0, use_linear=True)
+    y.backward()
+    # class 1 violates: grad +1 there, -1 at true class
+    np.testing.assert_allclose(x.grad.asnumpy(), [[-1.0, 1.0]], atol=1e-6)
+
+
+def _scipy():
+    return pytest.importorskip("scipy.stats")
+
+
+def test_pdf_ops_match_scipy():
+    st = _scipy()
+    s = np.array([[0.25, 0.5, 2.0]], "f")
+    checks = [
+        ("random_pdf_uniform", (np.array([0.0], "f"), np.array([3.0], "f")),
+         st.uniform.pdf(s, 0.0, 3.0)),
+        ("random_pdf_normal", (np.array([1.0], "f"), np.array([2.0], "f")),
+         st.norm.pdf(s, 1.0, 2.0)),
+        ("random_pdf_gamma", (np.array([2.0], "f"), np.array([1.5], "f")),
+         st.gamma.pdf(s, a=2.0, scale=1 / 1.5)),
+        ("random_pdf_exponential", (np.array([1.5], "f"),),
+         st.expon.pdf(s, scale=1 / 1.5)),
+    ]
+    for name, params, want in checks:
+        got = getattr(nd, name)(
+            nd.array(s), *[nd.array(p) for p in params]).asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7,
+                                   err_msg=name)
+        logp = getattr(nd, name)(
+            nd.array(s), *[nd.array(p) for p in params],
+            is_log=True).asnumpy()
+        np.testing.assert_allclose(np.exp(logp), want, rtol=1e-5,
+                                   atol=1e-7, err_msg=name + " is_log")
+
+
+def test_pdf_discrete_ops_match_scipy():
+    st = _scipy()
+    ks = np.array([[0.0, 1.0, 4.0]], "f")
+    got = nd.random_pdf_poisson(nd.array(ks), nd.array([2.5])).asnumpy()
+    np.testing.assert_allclose(got, st.poisson.pmf(ks, 2.5), rtol=1e-5)
+    got = nd.random_pdf_negative_binomial(
+        nd.array(ks), nd.array([3.0]), nd.array([0.4])).asnumpy()
+    np.testing.assert_allclose(got, st.nbinom.pmf(ks, 3, 0.4), rtol=1e-5)
+    # generalized NB at alpha=1/r reduces to NB with p = r/(r+mu)
+    mu, alpha = 2.0, 0.5
+    r = 1.0 / alpha
+    got = nd.random_pdf_generalized_negative_binomial(
+        nd.array(ks), nd.array(np.array([mu], "f")),
+        nd.array(np.array([alpha], "f"))).asnumpy()
+    np.testing.assert_allclose(got, st.nbinom.pmf(ks, r, r / (r + mu)),
+                               rtol=1e-5)
+
+
+def test_pdf_dirichlet_matches_scipy():
+    st = _scipy()
+    alpha = np.array([1.5, 2.0, 0.8], "f")
+    x = np.random.RandomState(0).dirichlet(alpha, size=4).astype("f")
+    got = nd.random_pdf_dirichlet(
+        nd.array(x[None]), nd.array(alpha[None])).asnumpy()
+    want = np.array([st.dirichlet.pdf(xi, alpha) for xi in x], "f")[None]
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_pdf_ops_differentiable_wrt_params():
+    """The reference hand-codes pdf gradients wrt parameters; here jax
+    derives them — check against a numeric diff."""
+    s = nd.array(np.array([[0.7, 1.3]], "f"))
+    mu = nd.array(np.array([0.5], "f"))
+    sg = nd.array(np.array([1.2], "f"))
+    mu.attach_grad()
+    sg.attach_grad()
+    with autograd.record():
+        p = nd.random_pdf_normal(s, mu, sg, is_log=True)
+        loss = p.sum()
+    loss.backward()
+    eps = 1e-3
+
+    def f(m, g):
+        return float(nd.random_pdf_normal(
+            s, nd.array([m]), nd.array([g]), is_log=True).sum().asscalar())
+
+    num_mu = (f(0.5 + eps, 1.2) - f(0.5 - eps, 1.2)) / (2 * eps)
+    num_sg = (f(0.5, 1.2 + eps) - f(0.5, 1.2 - eps)) / (2 * eps)
+    assert abs(float(mu.grad.asscalar()) - num_mu) < 1e-2
+    assert abs(float(sg.grad.asscalar()) - num_sg) < 1e-2

@@ -361,3 +361,31 @@ def test_llama_moe_aux_loss_reaches_router():
         routers[w] = np.asarray(step.train_params[rname])
     assert not np.allclose(routers[0.0], routers[0.5], atol=1e-7)
     assert np.isfinite(routers[0.5]).all()
+
+
+def test_llama_moe_exports_through_symbol_path(tmp_path):
+    """MoE models trace to Symbol, export, and reload via SymbolBlock
+    with identical outputs (closes the r4 caveat: moe_swiglu is now a
+    registered op instead of a raw apply_fn seam)."""
+    import numpy as np
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo.language import llama
+
+    cfg = llama.LlamaConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                            num_heads=2, num_kv_heads=2,
+                            intermediate_size=24, max_seq_len=16,
+                            num_experts=4)
+    net = llama.LlamaForCausalLM(cfg)
+    net.initialize()
+    net.hybridize()
+    ids = mx.nd.array(np.random.RandomState(0).randint(0, 64, (2, 8)),
+                      dtype="int32")
+    y0 = net(ids).asnumpy()
+
+    path = str(tmp_path / "llama_moe")
+    net.export(path, 0, ids)
+    re = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                   path + "-0000.params")
+    y1 = re(ids).asnumpy()
+    np.testing.assert_allclose(y1, y0, rtol=1e-4, atol=1e-5)

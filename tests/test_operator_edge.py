@@ -402,3 +402,70 @@ def test_spatial_transformer_rejects_unsupported_modes():
     with pytest.raises(Exception):
         nd.SpatialTransformer(img, loc, target_shape=(4, 4),
                               transform_type="warp")
+
+
+def test_deconvolution_channel_last_matches_channel_first():
+    """Deconvolution NWC/NHWC/NDHWC (weight (in, *k, out/g)) matches the
+    channel-first result transposed, across stride/pad/adj/dilate/groups
+    and bias (closes the r4 caveat; reference: deconvolution.cc)."""
+    rs = np.random.RandomState(0)
+    cases = [
+        # (ndim, N, C_in, spatial, C_out, k, s, p, a, d, g)
+        (1, 2, 4, (7,), 6, (3,), (2,), (1,), (1,), (1,), 1),
+        (2, 2, 4, (5, 6), 6, (3, 2), (2, 1), (1, 0), (0, 0), (1, 1), 1),
+        (2, 2, 4, (4, 4), 6, (2, 2), (2, 2), (0, 0), (1, 1), (1, 1), 2),
+        (2, 1, 3, (5, 5), 3, (3, 3), (1, 1), (1, 1), (0, 0), (2, 2), 3),
+        (3, 1, 2, (3, 4, 3), 4, (2, 2, 2), (2, 1, 2), (0, 1, 0),
+         (0, 0, 0), (1, 1, 1), 1),
+    ]
+    cl_layouts = {1: "NWC", 2: "NHWC", 3: "NDHWC"}
+    for nd_, N, Ci, sp, Co, k, s, p, a, d, g in cases:
+        x_cf = rs.randn(N, Ci, *sp).astype("f")
+        w_cf = rs.randn(Ci, Co // g, *k).astype("f") * 0.3
+        b = rs.randn(Co).astype("f")
+        y_cf = mx.nd.Deconvolution(
+            mx.nd.array(x_cf), mx.nd.array(w_cf), mx.nd.array(b),
+            kernel=k, stride=s, pad=p, adj=a, dilate=d, num_filter=Co,
+            num_group=g, no_bias=False).asnumpy()
+        # channel-last: x (N, *sp, C), w (in, *k, out/g)
+        perm_x = (0,) + tuple(range(2, nd_ + 2)) + (1,)
+        perm_w = (0,) + tuple(range(2, nd_ + 2)) + (1,)
+        x_cl = np.transpose(x_cf, perm_x)
+        w_cl = np.transpose(w_cf, perm_w)
+        y_cl = mx.nd.Deconvolution(
+            mx.nd.array(x_cl), mx.nd.array(w_cl), mx.nd.array(b),
+            kernel=k, stride=s, pad=p, adj=a, dilate=d, num_filter=Co,
+            num_group=g, no_bias=False,
+            layout=cl_layouts[nd_]).asnumpy()
+        perm_back = (0, nd_ + 1) + tuple(range(1, nd_ + 1))
+        np.testing.assert_allclose(np.transpose(y_cl, perm_back), y_cf,
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_conv2dtranspose_nhwc_layer_trains():
+    """Gluon Conv2DTranspose(layout='NHWC') infers weight shape, matches
+    the NCHW layer's output, and takes gradient steps."""
+    from mxnet_tpu import autograd, gluon
+
+    rs = np.random.RandomState(1)
+    x_cf = rs.randn(2, 3, 5, 5).astype("f")
+    lc = gluon.nn.Conv2DTranspose(6, 3, strides=2, padding=1,
+                                  output_padding=1, layout="NCHW")
+    lc.initialize()
+    y_cf = lc(mx.nd.array(x_cf))
+    ll = gluon.nn.Conv2DTranspose(6, 3, strides=2, padding=1,
+                                  output_padding=1, layout="NHWC")
+    ll.initialize()
+    ll(mx.nd.array(np.transpose(x_cf, (0, 2, 3, 1))))  # settle shapes
+    # copy NCHW weights into the NHWC parameterization
+    w = lc.weight.data().asnumpy()          # (in, out, kh, kw)
+    ll.weight.set_data(mx.nd.array(np.transpose(w, (0, 2, 3, 1))))
+    ll.bias.set_data(lc.bias.data())
+    y_cl = ll(mx.nd.array(np.transpose(x_cf, (0, 2, 3, 1))))
+    np.testing.assert_allclose(np.transpose(y_cl.asnumpy(), (0, 3, 1, 2)),
+                               y_cf.asnumpy(), rtol=1e-4, atol=1e-4)
+    # gradient step
+    with autograd.record():
+        loss = (ll(mx.nd.array(np.transpose(x_cf, (0, 2, 3, 1)))) ** 2).mean()
+    loss.backward()
+    assert np.isfinite(ll.weight.grad().asnumpy()).all()

@@ -227,7 +227,67 @@ def test_fused_rnn_lstm_state_clip_per_step():
     assert np.abs(out.asnumpy()).max() <= np.tanh(0.25) + 1e-6
 
 
-def test_fused_rnn_use_sequence_length_raises():
+def test_fused_rnn_use_sequence_length_matches_truncated_runs():
+    """use_sequence_length masks the recurrence: outputs past each
+    sample's length are zero, final states are the states at the last
+    valid step, and the reverse direction runs over the valid prefix
+    (reference: rnn.cc use_sequence_length; closes the r4 caveat)."""
+    from mxnet_tpu.ops.nn import rnn_param_size
+
+    T, N, C, H = 5, 3, 2, 4
+    rs = np.random.RandomState(0)
+    x = rs.randn(T, N, C).astype("f") * 0.5
+    flat = rs.randn(
+        rnn_param_size("lstm", C, H, num_layers=2, bidirectional=True)
+    ).astype("f") * 0.3
+    lens = np.array([5, 3, 1], "i")
+    h0 = np.zeros((4, N, H), "f")
+    c0 = np.zeros((4, N, H), "f")
+    out, hf, cf = mx.nd.RNN(
+        mx.nd.array(x), mx.nd.array(flat), mx.nd.array(h0), mx.nd.array(c0),
+        mx.nd.array(lens), state_size=H, num_layers=2, mode="lstm",
+        bidirectional=True, state_outputs=True, use_sequence_length=True)
+    for n, L in enumerate(lens):
+        o_n, h_n, c_n = mx.nd.RNN(
+            mx.nd.array(x[:L, n:n + 1]), mx.nd.array(flat),
+            mx.nd.array(h0[:, n:n + 1]), mx.nd.array(c0[:, n:n + 1]),
+            state_size=H, num_layers=2, mode="lstm", bidirectional=True,
+            state_outputs=True)
+        assert np.allclose(out.asnumpy()[:L, n], o_n.asnumpy()[:, 0],
+                           atol=1e-5)
+        assert np.allclose(out.asnumpy()[L:, n], 0.0)
+        assert np.allclose(hf.asnumpy()[:, n], h_n.asnumpy()[:, 0],
+                           atol=1e-5)
+        assert np.allclose(cf.asnumpy()[:, n], c_n.asnumpy()[:, 0],
+                           atol=1e-5)
+
+
+def test_fused_rnn_use_sequence_length_gru_grads_flow():
+    """Gradients flow through the masked scan and are zero for padded
+    steps' inputs."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ops.nn import rnn_param_size
+
+    T, N, C, H = 4, 2, 3, 5
+    rs = np.random.RandomState(1)
+    x = mx.nd.array(rs.randn(T, N, C).astype("f"))
+    flat = mx.nd.array(
+        rs.randn(rnn_param_size("gru", C, H)).astype("f") * 0.3)
+    h0 = mx.nd.zeros((1, N, H))
+    lens = mx.nd.array(np.array([4, 2], "i"))
+    x.attach_grad()
+    with autograd.record():
+        out = mx.nd.RNN(x, flat, h0, lens, state_size=H, mode="gru",
+                        use_sequence_length=True)
+        loss = out.sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    assert np.abs(g[:4, 0]).sum() > 0
+    assert np.allclose(g[2:, 1], 0.0)  # padded steps get no gradient
+    assert np.abs(g[:2, 1]).sum() > 0
+
+
+def test_fused_rnn_use_sequence_length_requires_input():
     import pytest
 
     from mxnet_tpu.ops.nn import rnn_param_size

@@ -432,3 +432,25 @@ def test_host_sparse_state_survives_dense_transitions_and_saveload():
         host2 = kv2._store["e"]
         np.testing.assert_allclose(host2.table, w_exp2, rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_pull_only_promotion_demotes_on_dense_push():
+    """A key promoted only by row_sparse_pull (e.g. sampled eval of a
+    dense-trained table) must NOT stay host-resident once dense gradient
+    traffic resumes — dense training keeps the device path (review
+    finding r5)."""
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.kvstore import _HostRowSparseTable
+
+    kv = mx.kv.create("local")
+    kv.init("w", nd.array(np.ones((8, 2), "f")))
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.5, momentum=0.9))
+    out = nd.zeros((2, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=nd.array([1, 3]))
+    assert isinstance(kv._store["w"], _HostRowSparseTable)
+    kv.push("w", nd.array(np.ones((8, 2), "f")))      # dense traffic
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    assert type(kv._store["w"]) is NDArray            # demoted
+    full = nd.zeros((8, 2))
+    kv.pull("w", out=full)
+    np.testing.assert_allclose(full.asnumpy(), 0.5)   # sgd applied once
