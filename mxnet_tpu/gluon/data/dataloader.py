@@ -58,6 +58,33 @@ _worker_dataset = None
 def _worker_initializer(dataset):
     global _worker_dataset
     _worker_dataset = dataset
+    # pin any jax use in this child to CPU BEFORE its first dispatch (env
+    # alone is not enough where a sitecustomize force-selects the platform
+    # via jax config); effective for spawn children and for fork children
+    # whose parent has not initialized a device backend yet
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def _parent_device_runtime_active():
+    """True if this process has already initialized a non-CPU jax backend —
+    fork()ing then dispatching in the child would reuse the inherited TPU
+    client (the axon tunnel is single-client), so the loader switches to
+    spawn in that case."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return any(p != "cpu" for p in xla_bridge._backends)
+    except Exception:
+        return True  # unknown runtime state: be conservative
 
 
 class _WorkerFn:
@@ -119,22 +146,22 @@ class DataLoader:
         _MultiWorkerIter).  Workers produce numpy batches (pickle
         transport); the parent converts to NDArray.
 
-        Children must not touch the parent's device runtime: workers are
-        created with JAX_PLATFORMS=cpu in the environment, so a dataset
-        that dispatches an NDArray op (or asnumpy) in a child initializes
-        at most a CPU backend — never a second TPU client (the axon tunnel
-        is single-client).  Start method defaults to fork (fast; same
-        caveat as the reference's multiprocessing loader); set
-        MXNET_MP_START_METHOD=spawn for a clean-slate child at higher
-        startup cost."""
+        Children must not touch the parent's device runtime: the worker
+        initializer pins jax to CPU before any dispatch, and if the parent
+        has ALREADY initialized a non-CPU backend the pool switches from
+        fork to spawn (a forked child would inherit the live TPU client —
+        the axon tunnel is single-client).  Override the start method with
+        MXNET_MP_START_METHOD=fork|spawn."""
         import multiprocessing as mp
         import os
 
         fn = self._batchify_fn
         if fn is default_batchify_fn:
             fn = default_mp_batchify_fn
-        ctx = mp.get_context(os.environ.get("MXNET_MP_START_METHOD",
-                                            "fork"))
+        method = os.environ.get("MXNET_MP_START_METHOD")
+        if method is None:
+            method = "spawn" if _parent_device_runtime_active() else "fork"
+        ctx = mp.get_context(method)
         prev = os.environ.get("JAX_PLATFORMS")
         os.environ["JAX_PLATFORMS"] = "cpu"
         try:
@@ -147,12 +174,20 @@ class DataLoader:
             else:
                 os.environ["JAX_PLATFORMS"] = prev
         # bound in-flight work: imap's feeder thread would otherwise
-        # enqueue the whole epoch and buffer every finished batch
+        # enqueue the whole epoch and buffer every finished batch.  The
+        # stop event unblocks the feeder if the consumer abandons the
+        # iterator early — pool.join() must not wait on a feeder thread
+        # parked in sem.acquire().
         sem = threading.BoundedSemaphore(self._num_workers + self._prefetch)
+        stop = threading.Event()
 
         def gated():
             for b in self._batch_sampler:
-                sem.acquire()
+                while not sem.acquire(timeout=0.1):
+                    if stop.is_set():
+                        return
+                if stop.is_set():
+                    return
                 yield b
 
         try:
@@ -160,6 +195,7 @@ class DataLoader:
                 sem.release()
                 yield _to_nd(out)
         finally:
+            stop.set()
             pool.terminate()
             pool.join()
 

@@ -725,12 +725,11 @@ def _rnn_scan_dir(jnp, mode, xs, h0, c0, wi, wh, bi, bh,
 
 def _reverse_sequence(jnp, x, seq_len):
     """Reverse each sample's valid prefix along axis 0, leaving padding in
-    place (reference: sequence_reverse.cc with sequence_length)."""
-    T = x.shape[0]
-    t = jnp.arange(T)[:, None]                       # (T, 1)
-    idx = jnp.where(t < seq_len[None, :], seq_len[None, :] - 1 - t, t)
-    return jnp.take_along_axis(x, idx.reshape(idx.shape + (1,) *
-                                              (x.ndim - 2)), axis=0)
+    place — delegates to the registered sequence_reverse kernel so the RNN
+    path and the SequenceReverse op cannot diverge."""
+    from .tensor import sequence_reverse
+
+    return sequence_reverse(x, seq_len, use_sequence_length=True, axis=0)
 
 
 @register("RNN", aliases=("rnn",), nout="dynamic", needs_rng=True)

@@ -248,9 +248,10 @@ class Adam(Optimizer):
 
 @register
 class AdaGrad(Optimizer):
-    def __init__(self, eps=1e-7, **kwargs):
+    def __init__(self, eps=1e-7, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
+        self.lazy_update = lazy_update  # sparse grads touch only their rows
 
     def create_state(self, index, weight):
         return _zeros_like(weight)

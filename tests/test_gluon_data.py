@@ -100,3 +100,16 @@ def test_dataloader_custom_batchify_in_process_mode():
         batchify_fn=batchify)]
     np.testing.assert_array_equal(
         np.concatenate(got), np.arange(12, dtype="f") * 2)
+
+
+def test_dataloader_process_mode_abandoned_iteration_no_deadlock():
+    """Breaking out of a process-worker epoch early must not hang the
+    parent on pool teardown (review finding r5: the semaphore-gated
+    feeder thread needs the stop signal)."""
+    ds = ArrayDataset(np.arange(64, dtype="f"))
+    t0 = time.perf_counter()
+    for i, b in enumerate(DataLoader(ds, batch_size=2, shuffle=False,
+                                     num_workers=2, thread_pool=False)):
+        if i == 0:
+            break
+    assert time.perf_counter() - t0 < 30.0
