@@ -251,6 +251,67 @@ class LlamaForCausalLM(HybridBlock):
     def config(self):
         return self._cfg
 
+    def pipeline_decompose(self, n_stages, train_mode=True):
+        """Split the net for pipeline parallelism: embed (pre) ->
+        ``n_stages`` homogeneous trunk stages of ``num_layers/n_stages``
+        decoder layers each -> final norm + lm_head (post).
+
+        The heterogeneous ends run OUTSIDE the pp loop (replicated /
+        dp-sharded), the uniform trunk streams through
+        ``parallel.pipeline_parallel.pipeline_apply`` — consumed by
+        ``TrainStep(pipeline=...)``.
+
+        Returns a dict: ``pre_names``/``post_names`` (parameter-name
+        groups), ``layer_names`` (per layer, {layer0-name: this-layer
+        name}), and pure ``pre_fn(params_sub, rng, ids)``,
+        ``layer_fn(layer_params_keyed_like_layer0, rng, h)``,
+        ``post_fn(params_sub, rng, h)``.
+        """
+        from ....parallel.functional import functionalize
+
+        cfg = self._cfg
+        L = cfg.num_layers
+        if L % n_stages:
+            raise MXNetError(
+                f"num_layers {L} not divisible by pipeline stages "
+                f"{n_stages}")
+        model = self.model
+        embed_apply, embed_p = functionalize(model.embed_tokens,
+                                             train_mode=train_mode)
+        lay0 = model.layers[0]
+        lay_apply, lay0_p = functionalize(lay0, train_mode=train_mode)
+        norm_apply, norm_p = functionalize(model.norm,
+                                           train_mode=train_mode)
+        head_apply, head_p = functionalize(self.lm_head,
+                                           train_mode=train_mode)
+        layer_names = []
+        for i in range(L):
+            blk = model.layers[i]
+            rel = {name[len(blk.prefix):]: name
+                   for name in blk.collect_params()}
+            layer_names.append(
+                {k0: rel[k0[len(lay0.prefix):]] for k0 in lay0_p})
+
+        def pre_fn(psub, rng, ids):
+            return embed_apply(psub, rng, ids)
+
+        def layer_fn(pl, rng, h):
+            return lay_apply(pl, rng, h)
+
+        def post_fn(psub, rng, h):
+            h = norm_apply({k: psub[k] for k in norm_p}, rng, h)
+            return head_apply({k: psub[k] for k in head_p}, rng, h)
+
+        return {
+            "pre_names": list(embed_p),
+            "post_names": list(norm_p) + list(head_p),
+            "layer_names": layer_names,
+            "layer0_names": list(lay0_p),
+            "pre_fn": pre_fn,
+            "layer_fn": layer_fn,
+            "post_fn": post_fn,
+        }
+
 
 def llama3_8b(**overrides):
     """The BASELINE config-#5 architecture (Llama-3-8B dims)."""

@@ -129,7 +129,14 @@ class TrainStep:
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, param_sharding="replicated", extra_param_specs=None,
                  batch_axes=("dp", "fsdp"), donate=True, train_mode=True,
-                 dtype=None):
+                 dtype=None, pipeline=None):
+        """``pipeline``: dict enabling pipeline parallelism over a mesh
+        axis — {'num_microbatches': M, 'axis': 'pp', 'schedule':
+        'gpipe'|'1f1b', 'remat_stage': bool}.  The net must implement
+        ``pipeline_decompose(n_stages, train_mode)`` (the model zoo's
+        LlamaForCausalLM does): heterogeneous embed/head ends run outside
+        the pipe, the homogeneous trunk streams over pp, and dp/fsdp
+        batch axes compose with it in the same jit."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -139,6 +146,25 @@ class TrainStep:
                                          with_state=train_mode)
         self._apply_fn = apply_fn
         self._with_state = train_mode
+        self._pipeline = None
+        if pipeline is not None:
+            if mesh is None:
+                raise MXNetError("pipeline parallelism needs a mesh")
+            pp_axis = pipeline.get("axis", "pp")
+            if pp_axis not in mesh.axis_names:
+                raise MXNetError(f"mesh has no {pp_axis!r} axis")
+            decomp = net.pipeline_decompose(mesh.shape[pp_axis],
+                                            train_mode=train_mode)
+            self._pipeline = {
+                "M": int(pipeline["num_microbatches"]),
+                "axis": pp_axis,
+                "schedule": pipeline.get("schedule", "gpipe"),
+                "remat_stage": bool(pipeline.get("remat_stage", False)),
+                "decomp": decomp,
+                "batch_axes": tuple(a for a in batch_axes
+                                    if a in mesh.axis_names
+                                    and a != pp_axis),
+            }
         # split trainable vs frozen/state params (grad_req='null' covers
         # BatchNorm running stats and user-frozen params): gradients and
         # optimizer updates apply only to the trainable set
@@ -217,12 +243,51 @@ class TrainStep:
 
             amp_scope = partial(_cast_scope, dtype)
 
+        pipeline_cfg = self._pipeline
+        mesh_ = mesh
+
+        def pipelined_forward(p, rng, x):
+            from .pipeline_parallel import pipeline_apply, stack_stage_params
+
+            d = pipeline_cfg["decomp"]
+            S = mesh_.shape[pipeline_cfg["axis"]]
+            L = len(d["layer_names"])
+            per = L // S
+            h = d["pre_fn"]({k: p[k] for k in d["pre_names"]}, rng, x)
+            # leaves (S, per, ...): inner stack = layers within a stage,
+            # outer stack = the stage-major axis pipeline_apply shards
+            stage_trees = [
+                stack_stage_params(
+                    [{k0: p[d["layer_names"][li][k0]]
+                      for k0 in d["layer0_names"]}
+                     for li in range(si * per, (si + 1) * per)])
+                for si in range(S)]
+            stacked = stack_stage_params(stage_trees)
+
+            def stage_fn(sp, h_mb):
+                def body(hh, pl):
+                    return d["layer_fn"](pl, rng, hh), None
+
+                out, _ = jax.lax.scan(body, h_mb, sp)
+                return out
+
+            h = pipeline_apply(
+                stage_fn, stacked, h, mesh_, pipeline_cfg["M"],
+                axis=pipeline_cfg["axis"],
+                schedule=pipeline_cfg["schedule"],
+                remat_stage=pipeline_cfg["remat_stage"],
+                batch_axes=pipeline_cfg["batch_axes"])
+            return d["post_fn"]({k: p[k] for k in d["post_names"]}, rng, h)
+
         def step(train_params, rest_params, opt_state, rng, x, y):
             def loss_of(tp):
                 p = dict(rest_params)
                 p.update(tp)
                 with amp_scope():
-                    if with_state:
+                    if pipeline_cfg is not None:
+                        out = pipelined_forward(p, rng, x)
+                        state = {}
+                    elif with_state:
                         out, state = apply_fn(p, rng, x)
                     else:
                         out = apply_fn(p, rng, x)

@@ -1,4 +1,4 @@
-"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe + 1F1B).
 
 Capability upgrade over the reference (MXNet 1.x has no pipeline
 parallelism; its answer to big models was parameter servers).  TPU-native
@@ -8,16 +8,22 @@ with ``lax.ppermute`` passing activations over ICI between ticks.  With M
 microbatches and S stages the loop runs M+S-1 ticks and every device is
 busy in the steady state (bubble fraction (S-1)/(M+S-1)).
 
-The whole schedule is one jit-able, differentiable function —
-``jax.grad`` through it gives 1F1B-equivalent memory behavior when
-combined with per-stage ``jax.checkpoint``.
+Two schedules:
 
-Usage::
+- ``schedule='gpipe'`` (default): the whole tick loop is one
+  reverse-differentiable ``lax.scan``; jax AD stores per-tick residuals
+  (or recomputes them under ``remat_stage=True``).
+- ``schedule='1f1b'``: a hand-written ``jax.custom_vjp`` backward in 1F1B
+  order — the forward stashes ONLY each microbatch's stage input (M
+  small buffers per device); the backward replays stages one microbatch
+  at a time (recompute + vjp), streaming activation cotangents upstream
+  over the reverse ppermute ring.  Peak activation memory is O(M input
+  stashes + 1 in-flight), independent of the tick count — the property
+  the 1F1B schedule exists for.
 
-    S = mesh.shape["pp"]
-    # stage_params: pytree whose leaves have leading axis S (stage-major)
-    out = pipeline_apply(stage_fn, stage_params, x, mesh,
-                         num_microbatches=M)
+Composes with data parallelism: pass ``batch_axes`` to shard the
+microbatch dimension over dp/fsdp while the pipeline runs over pp
+(collectives stay inside their own mesh axes).
 """
 from __future__ import annotations
 
@@ -37,20 +43,25 @@ def stack_stage_params(per_stage_params):
 
 
 def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
-                   axis="pp", remat_stage=False):
+                   axis="pp", remat_stage=False, schedule="gpipe",
+                   batch_axes=()):
     """Run ``stage_fn`` as an S-stage pipeline over the mesh's pp axis.
 
     stage_fn(params_one_stage, x_mb) -> y_mb, where y_mb has x_mb's shape
-    (homogeneous stages — the transformer-stack case).
+    (homogeneous stages — the transformer-trunk case; heterogeneous
+    embed/head ends run OUTSIDE the pipeline, see TrainStep(pipeline=...)).
     stage_params: pytree, leaves shaped (S, ...); sharded over pp here.
-    x: global batch, leading dim divisible by num_microbatches.
-    Returns stage_{S-1}(...stage_0(x)) with the same sharding as x.
+    x: global batch, leading dim divisible by num_microbatches (and by
+    the product of ``batch_axes`` mesh axes, which shard it).
+    Returns stage_{S-1}(...stage_0(x)) with x's sharding.
     """
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
+    if schedule not in ("gpipe", "1f1b"):
+        raise MXNetError(f"unknown pipeline schedule {schedule!r}")
     S = mesh.shape[axis]
     M = int(num_microbatches)
     if x.shape[0] % M:
@@ -67,60 +78,141 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
         return P(axis, *([None] * (leaf.ndim - 1)))
 
     pspecs = jax.tree_util.tree_map(leaf_spec, stage_params)
-    stage_params = jax.tree_util.tree_map(
-        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
-        stage_params, pspecs)
+    traced = any(isinstance(leaf, jax.core.Tracer)
+                 for leaf in jax.tree_util.tree_leaves(stage_params))
+    if traced:
+        # inside an outer jit (TrainStep): annotate, don't device_put
+        stage_params = jax.tree_util.tree_map(
+            lambda leaf, spec: jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, spec)),
+            stage_params, pspecs)
+    else:
+        stage_params = jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(leaf,
+                                              NamedSharding(mesh, spec)),
+            stage_params, pspecs)
 
     if remat_stage:
+        # gpipe: AD recomputes per-tick; 1f1b: bounds the intra-stage
+        # residuals each per-microbatch jax.vjp in the backward stores
         stage_fn = jax.checkpoint(stage_fn)
 
-    def pp_fn(params_local, xs):
-        # params_local: leaves (1, ...) — this device's stage
-        # xs: (M, mb, ...) microbatched input (replicated over pp)
-        s = jax.lax.axis_index(axis)
-        p_one = jax.tree_util.tree_map(lambda l: l[0], params_local)
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+    def run_forward(xs, p_one, s, stash):
+        """The M+S-1 tick loop.  When ``stash`` is True, also record each
+        microbatch's stage INPUT (the 1f1b residual)."""
         mb_shape = xs.shape[1:]
-        state = jnp.zeros(mb_shape, xs.dtype)     # activation in flight
+        state = jnp.zeros(mb_shape, xs.dtype)
         outputs = jnp.zeros_like(xs)
-        perm = [(i, (i + 1) % S) for i in range(S)]
+        saved = jnp.zeros_like(xs) if stash else None
 
         def tick(carry, t):
-            state, outputs = carry
-            # stage 0 ingests microbatch t (garbage after t >= M is
-            # masked out on the output side)
+            state, outputs, saved = carry
             mb_in = xs[jnp.minimum(t, M - 1)]
             inp = jnp.where(s == 0, mb_in, state)
-            # double-where: on bubble ticks (device s busy only for
-            # s <= t < s+M) substitute a finite placeholder, so stage_fn
-            # never evaluates on garbage — otherwise a NaN-capable stage
-            # poisons the BACKWARD pass (0 cotangent x NaN Jacobian = NaN)
-            # even though the forward masks discard the value
+            # double-where: on bubble ticks substitute a finite
+            # placeholder so stage_fn never evaluates on garbage (a NaN
+            # Jacobian x 0 cotangent would still poison the backward)
             valid = (t >= s) & (t < s + M)
             inp = jnp.where(valid, inp, xs[0])
+            if stash:
+                mi = jnp.clip(t - s, 0, M - 1)
+                saved = saved.at[mi].set(
+                    jnp.where(valid, inp, saved[mi]))
             out = stage_fn(p_one, inp)
-            # last stage completed microbatch t-(S-1) at this tick
             done_idx = t - (S - 1)
             write = (s == S - 1) & (done_idx >= 0)
             di = jnp.maximum(done_idx, 0)
-            # jnp.where (not arithmetic masking): warmup-tick garbage can
-            # be NaN and NaN*0 would poison valid outputs
             outputs = outputs.at[di].set(
                 jnp.where(write, out, outputs[di]))
-            # pass activations downstream (stage S-1 -> 0 link carries
-            # garbage; stage 0 ignores its input)
-            state = jax.lax.ppermute(out, axis, perm)
-            return (state, outputs), None
+            state = jax.lax.ppermute(out, axis, perm_fwd)
+            return (state, outputs, saved), None
 
-        # scan (not fori_loop): the schedule must be reverse-differentiable
-        (_, outputs), _ = jax.lax.scan(tick, (state, outputs),
-                                       jnp.arange(M + S - 1))
-        # result lives on the last stage; broadcast over pp
+        (_, outputs, saved), _ = jax.lax.scan(
+            tick, (state, outputs, saved), jnp.arange(M + S - 1))
         outputs = jnp.where(s == S - 1, outputs, jnp.zeros_like(outputs))
-        return jax.lax.psum(outputs, axis)
+        return jax.lax.psum(outputs, axis), saved
+
+    def pp_fn(params_local, xs):
+        p_one = jax.tree_util.tree_map(lambda l: l[0], params_local)
+
+        if schedule == "gpipe":
+            out, _ = run_forward(xs, p_one, jax.lax.axis_index(axis),
+                                 stash=False)
+            return out
+
+        # NOTE: each custom_vjp piece recomputes axis_index itself —
+        # closing over the tracer from pp_fn would leak it into the
+        # separately-traced fwd/bwd functions
+
+        @jax.custom_vjp
+        def f(p_one, xs):
+            out, _ = run_forward(xs, p_one, jax.lax.axis_index(axis),
+                                 stash=False)
+            return out
+
+        def f_fwd(p_one, xs):
+            out, saved = run_forward(xs, p_one, jax.lax.axis_index(axis),
+                                     stash=True)
+            # residual: saved only (same (M, mb, ...) shape as xs) — also
+            # carrying xs would double the stashed-activation footprint
+            # the 1F1B schedule exists to minimize
+            return out, (p_one, saved)
+
+        def f_bwd(res, d_out):
+            # 1F1B-ordered backward: reverse ticks; each device handles
+            # the cotangent of one microbatch per tick, recomputing its
+            # stage forward from the stashed input and streaming the
+            # input-cotangent upstream.  Live state: the M input stashes
+            # + one cotangent in flight — no per-tick residual stack.
+            p_one, saved = res
+            s = jax.lax.axis_index(axis)
+            # boundary convention (check_rep=False): the replicated
+            # output's cotangent arrives as d_true/S on each device; the
+            # forward's own psum transposes to psum, so recover d_true
+            # explicitly here
+            d_out = jax.lax.psum(d_out, axis)
+            dxs0 = jnp.zeros_like(saved)
+            dp0 = jax.tree_util.tree_map(jnp.zeros_like, p_one)
+            g0 = jnp.zeros(saved.shape[1:], saved.dtype)
+
+            def btick(carry, t):
+                g_state, dxs, dp = carry
+                m = t - s                       # microbatch this device
+                valid = (m >= 0) & (m < M)      # handles at reverse tick
+                mi = jnp.clip(m, 0, M - 1)
+                inp = saved[mi]
+                # last stage seeds from the output cotangent; upstream
+                # stages consume what flowed back over the ring
+                g_in = jnp.where(s == S - 1, d_out[mi], g_state)
+                g_in = jnp.where(valid, g_in, jnp.zeros_like(g_in))
+                _, vjp = jax.vjp(stage_fn, p_one, inp)
+                dp_t, dx = vjp(g_in)
+                dp = jax.tree_util.tree_map(lambda a, b: a + b, dp, dp_t)
+                dxs = dxs.at[mi].add(
+                    jnp.where(valid & (s == 0), dx,
+                              jnp.zeros_like(dx)))
+                g_state = jax.lax.ppermute(dx, axis, perm_bwd)
+                return (g_state, dxs, dp), None
+
+            # reverse order: tick M+S-2 first (the 1F1B tail) down to 0
+            (_, dxs, dp), _ = jax.lax.scan(
+                btick, (g0, dxs0, dp0),
+                jnp.arange(M + S - 2, -1, -1))
+            # xs is a replicated input: shard_map's own transpose psums
+            # per-device contributions (only stage 0's is nonzero), so
+            # return the local contribution un-summed
+            return dp, dxs
+
+        f.defvjp(f_fwd, f_bwd)
+        return f(p_one, xs)
 
     xs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
-    in_specs = (pspecs, P(*([None] * xs.ndim)))
-    out_spec = P(*([None] * xs.ndim))
+    bspec = tuple(batch_axes) if batch_axes else None
+    xs_spec = P(None, bspec, *([None] * (xs.ndim - 2)))
+    in_specs = (pspecs, xs_spec)
     y = shard_map(pp_fn, mesh=mesh, in_specs=in_specs,
-                  out_specs=out_spec, check_rep=False)(stage_params, xs)
+                  out_specs=xs_spec, check_rep=False)(stage_params, xs)
     return y.reshape(x.shape)
