@@ -5,7 +5,8 @@
 # Lanes:
 #   unit        CPU unit suite on the 8-virtual-device mesh (default)
 #   tpu         real-chip consistency lane (MXNET_TEST_TPU=1)
-#   dist        2-process launcher tests only
+#   dist        multi-process launcher tests (2- and 4-process lanes)
+#               + kill-worker recovery integration
 #   sanity      import + flake-level checks, no heavy tests
 #   bench       headline benchmarks (runs on whatever backend is live)
 set -euo pipefail
@@ -28,7 +29,8 @@ case "$LANE" in
     MXNET_TEST_TPU=1 python -m pytest tests/test_tpu_consistency.py -q
     ;;
   dist)
-    JAX_PLATFORMS=cpu python -m pytest tests/test_distributed.py -q
+    JAX_PLATFORMS=cpu python -m pytest -q tests/test_distributed.py \
+      "tests/test_checkpoint.py::test_kill_worker_recovery_resume_parity"
     ;;
   bench)
     python bench.py | tee BENCH.json

@@ -1,0 +1,73 @@
+"""Generic N-process dist_tpu_sync worker (reference:
+tests/nightly/dist_sync_kvstore.py via tools/launch.py --launcher local —
+SURVEY.md §5.4).  Unlike dist_worker.py (the fixed 2-process script with
+per-section hand-computed expectations) this scales to any process count:
+the 4-process CI lane runs it with -n 4."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu.parallel import distributed
+
+assert distributed.init(), "distributed.init must bootstrap from launcher env"
+
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_tpu_sync")
+rank, n = kv.rank, kv.num_workers
+expected_n = int(os.environ.get("DIST_TEST_NPROC", "0"))
+assert n == expected_n, f"expected {expected_n} workers, got {n}"
+
+# 1. push/pull: cross-process gradient sum over all N workers
+kv.init(3, mx.nd.zeros((4, 5)))
+kv.push(3, mx.nd.ones((4, 5)) * (rank + 1))
+out = mx.nd.zeros((4, 5))
+kv.pull(3, out)
+expect = float(sum(r + 1 for r in range(n)))
+np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+# 2. bucketed multi-key pushpull: one push call carrying all three small
+# keys drives the fused flatten->collective->slice path in
+# _allreduce_bucketed (per-key pushes would each take the single-value
+# branch and never exercise the offset reconstruction)
+keys = [10, 11, 12]
+for k in keys:
+    kv.init(k, mx.nd.zeros((3,)))
+kv.push(keys, [mx.nd.ones((3,)) * (rank + 1) * k for k in keys])
+for k in keys:
+    o = mx.nd.zeros((3,))
+    kv.pull(k, o)
+    np.testing.assert_allclose(o.asnumpy(), expect * k, rtol=1e-6)
+
+# 3. update_on_kvstore: sharded optimizer across N processes
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.0))
+w0 = np.arange(12, dtype="f").reshape(3, 4) / 10.0
+kv.init(7, mx.nd.array(w0))
+g_sum = np.full((3, 4), expect, dtype="f")
+mom = np.zeros_like(w0)
+w_ref = w0.copy()
+for it in range(2):
+    kv.push(7, mx.nd.array(np.full((3, 4), rank + 1.0, dtype="f")))
+    mom = 0.9 * mom + g_sum
+    w_ref = w_ref - 0.1 * mom
+    w = mx.nd.zeros((3, 4))
+    kv.pull(7, w)
+    np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-5)
+
+# 4. row_sparse_pull of the trained weight across processes
+rows = mx.nd.array(np.array([0, 2], "f"))
+rout = mx.nd.zeros((2, 4))
+kv.row_sparse_pull(7, out=rout, row_ids=rows)
+np.testing.assert_allclose(rout.asnumpy(), w_ref[[0, 2]], rtol=1e-5)
+
+marker = os.environ.get("DIST_TEST_MARKER")
+if marker:
+    with open(f"{marker}.{rank}", "w") as f:
+        f.write("ok")
+print(f"worker {rank}/{n} OK", file=sys.stderr)

@@ -1,0 +1,55 @@
+"""Training job for the kill-one-worker recovery test (reference axis:
+SURVEY.md §6.3 failure recovery; VERDICT r4 item 8).
+
+A deterministic linear-regression fit that checkpoints every step; on the
+first attempt (no checkpoint at/after RECOVERY_KILL_AT yet) it SIGKILLs
+itself mid-run — a real process death, not an in-process exception.  The
+supervising test re-runs it via checkpoint.run_with_recovery and asserts
+the resumed run's final weights exactly match an uninterrupted run."""
+import os
+import signal
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.checkpoint import CheckpointManager
+
+ckdir, total_steps, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+kill_at = int(os.environ.get("RECOVERY_KILL_AT", "-1"))
+
+net = gluon.nn.Dense(1, in_units=4, prefix="rec_")
+net.initialize(mx.init.Zero())
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9})
+mgr = CheckpointManager(ckdir, max_to_keep=3)
+start = mgr.restore(net, trainer)
+
+true_w = np.array([[1.0, -2.0, 0.5, 3.0]], "f")
+for step in range(start, total_steps):
+    rs = np.random.RandomState(1000 + step)    # per-step deterministic data
+    x = rs.randn(8, 4).astype("f")
+    y = x @ true_w.T
+    with autograd.record():
+        loss = ((net(mx.nd.array(x)) - mx.nd.array(y)) ** 2).mean()
+    loss.backward()
+    trainer.step(8)
+    if kill_at >= 0 and step + 1 == kill_at and \
+            not os.path.exists(ckdir + ".killed"):
+        # die ONCE, BEFORE committing this step: the resume must
+        # re-execute the in-flight step from the previous checkpoint —
+        # the lost-work scenario the atomic-publish design exists for
+        with open(ckdir + ".killed", "w") as f:
+            f.write("1")
+        os.kill(os.getpid(), signal.SIGKILL)   # simulated preemption
+    mgr.save(step + 1, net, trainer)
+
+np.savez(out_path, w=net.weight.data().asnumpy(),
+         b=net.bias.data().asnumpy(), steps=total_steps)
+print(f"finished at step {total_steps} (started {start})", file=sys.stderr)

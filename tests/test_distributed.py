@@ -171,3 +171,27 @@ def test_two_process_dist_kvstore(tmp_path):
     assert proc.returncode == 0, \
         f"dist workers failed:\n{proc.stdout}\n{proc.stderr}"
     assert os.path.exists(marker + ".0") and os.path.exists(marker + ".1")
+
+
+@pytest.mark.slow
+def test_four_process_dist_kvstore(tmp_path):
+    """4 real processes through tools/launch.py (VERDICT r4 item 8: the
+    2-process lane was the only multi-process evidence; pairs hide
+    count-dependent bugs).  Runs the generic N-worker script: allreduce
+    sum, bucketed multi-key pushpull, sharded optimizer over 4 ranks,
+    cross-process row_sparse_pull."""
+    marker = str(tmp_path / "marker4")
+    env = dict(os.environ)
+    env["DIST_TEST_MARKER"] = marker
+    env["DIST_TEST_NPROC"] = "4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"), "-n", "4",
+         "--launcher", "local", "--",
+         sys.executable, os.path.join(REPO, "tests", "dist_worker_n.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, \
+        f"dist workers failed:\n{proc.stdout}\n{proc.stderr}"
+    for r in range(4):
+        assert os.path.exists(f"{marker}.{r}"), f"rank {r} did not finish"
