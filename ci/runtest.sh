@@ -8,6 +8,7 @@
 #   dist        multi-process launcher tests (2- and 4-process lanes)
 #               + kill-worker recovery integration
 #   sanity      import + flake-level checks, no heavy tests
+#   nightly     large-tensor + model backwards-compat tier
 #   bench       headline benchmarks (runs on whatever backend is live)
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -32,11 +33,17 @@ case "$LANE" in
     JAX_PLATFORMS=cpu python -m pytest -q tests/test_distributed.py \
       "tests/test_checkpoint.py::test_kill_worker_recovery_resume_parity"
     ;;
+  nightly)
+    # large-tensor + model backwards-compatibility tier (reference:
+    # tests/nightly/ + model_backwards_compatibility_check/); set
+    # MXNET_TEST_LARGE=1 on real nightly hardware for >2**31 elements
+    JAX_PLATFORMS=cpu python -m pytest tests/nightly/ -q
+    ;;
   bench)
     python bench.py | tee BENCH.json
     ;;
   *)
-    echo "unknown lane: $LANE (unit|tpu|dist|sanity|bench)" >&2
+    echo "unknown lane: $LANE (unit|tpu|dist|sanity|nightly|bench)" >&2
     exit 2
     ;;
 esac

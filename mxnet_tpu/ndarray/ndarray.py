@@ -857,6 +857,15 @@ def array(source_array, ctx=None, dtype=None):
                 dtype = _np.int32
     if dtype is not None:
         v = _np.asarray(v).astype(_resolve_dtype(dtype)) if not hasattr(v, "astype") else v.astype(_resolve_dtype(dtype))
+    if getattr(v, "ndim", 1) == 0:
+        # reference semantics: the LEGACY nd namespace has no zero-dim
+        # arrays — scalars become shape (1,) — unless npx.set_np(shape=
+        # True) is active (mx.np.array is unaffected: numpy semantics are
+        # native there)
+        from ..numpy_extension import is_np_shape
+
+        if not is_np_shape():
+            v = _np.asarray(v).reshape(1)
     out = jax.device_put(jnp.asarray(v), ctx.device)
     return NDArray._from_jax(out, ctx)
 

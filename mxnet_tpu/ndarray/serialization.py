@@ -55,8 +55,10 @@ def save(fname, data):
     with open(fname, "wb") as f:
         f.write(struct.pack("<QQQ", _LIST_MAGIC, 0, len(arrays)))
         for arr in arrays:
-            np_arr = _np.ascontiguousarray(arr.asnumpy() if isinstance(arr, NDArray)
-                                           else _np.asarray(arr))
+            # order="C" (not ascontiguousarray, which silently promotes
+            # 0-d arrays to shape (1,)): scalars must round-trip exactly
+            np_arr = _np.asarray(arr.asnumpy() if isinstance(arr, NDArray)
+                                 else arr, order="C")
             dt = _dtype_name(np_arr.dtype.name if hasattr(np_arr.dtype, "name")
                              else np_arr.dtype)
             if dt not in _TYPE_FLAG:
@@ -100,7 +102,12 @@ def load(fname):
             nbytes = int(_np.prod(shape)) * np_dt.itemsize if shape else np_dt.itemsize
             buf = f.read(nbytes)
             np_arr = _np.frombuffer(buf, dtype=np_dt).reshape(shape)
-            arrays.append(_nd_array(np_arr, dtype=np_dt))
+            # bypass mx.nd.array: deserialization must reproduce the
+            # stored shape EXACTLY (nd.array promotes 0-d scalars to (1,)
+            # under legacy np_shape-off semantics)
+            import jax.numpy as _jnp_
+
+            arrays.append(NDArray._from_jax(_jnp_.asarray(np_arr), None))
         (n_names,) = struct.unpack("<Q", f.read(8))
         names = []
         for _ in range(n_names):

@@ -20,9 +20,15 @@ _np_shape = False
 
 def set_np(shape=True, array=True):
     """Enable/disable numpy semantics (reference: npx.set_np — the flags
-    deactivate when passed False).  Zero-dim shapes and numpy broadcasting
-    are native to this build, so the switch records intent for scripts that
-    query it."""
+    deactivate when passed False).
+
+    ``shape`` gates zero-dim support in the LEGACY ``mx.nd`` namespace:
+    off (the default), ``mx.nd.array(scalar)`` promotes to shape (1,)
+    exactly like the reference's legacy NDArray; on, scalars keep shape
+    ().  ``mx.np`` is unaffected — numpy semantics are native there.
+    ``array`` records intent only: ``mx.np.ndarray`` IS the framework
+    NDArray in this build, so there is no separate array type to switch
+    Gluon outputs to (the honest no-op, documented)."""
     global _np_array, _np_shape
     _np_array = bool(array)
     _np_shape = bool(shape)

@@ -1,0 +1,71 @@
+"""Large-tensor lane (reference: tests/nightly/test_large_array.py —
+SURVEY.md §5 nightly tier).
+
+Default sizes keep CI viable (~0.5 GB peak); MXNET_TEST_LARGE=1 scales to
+the reference's >2**31-element regime for real nightly hardware.  The
+hazards probed are the ones size exposes: accumulation error at huge
+reductions, indexing correctness at large offsets (beyond fp32's 2**24
+integer precision), and shape plumbing that silently truncates to int32.
+"""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+LARGE = os.environ.get("MXNET_TEST_LARGE") == "1"
+N = (1 << 31) + 7 if LARGE else (1 << 26) + 7       # elements, flat
+TALL = (1 << 28 if LARGE else 1 << 22, 16)          # tall matmul
+
+
+def test_large_flat_reductions_and_offsets():
+    # arange-like content without materializing python lists
+    x = nd.arange(0, N, dtype="float32")
+    # sum of 0..N-1 overflows fp32 accumulation unless pairwise/fp32-acc
+    # reduction is used; compare against the closed form in fp64
+    total = float(x.sum().asscalar())
+    expect = (N - 1) * N / 2.0
+    assert abs(total - expect) / expect < 1e-6
+    # relative tolerance: the fp32 VALUES of arange past 2**24 are
+    # themselves quantized, so the exact integer mean is unreachable
+    mean = float(x.mean().asscalar())
+    assert abs(mean - (N - 1) / 2.0) / ((N - 1) / 2.0) < 1e-6
+    # indexing far past 2**24 (where fp32 index math would corrupt)
+    off = N - 5
+    sl = x[off:off + 3].asnumpy()
+    np.testing.assert_allclose(sl, [off, off + 1, off + 2])
+    idx = nd.array(np.array([3, N - 2, 1 << 25], "i"), dtype="int32")
+    got = nd.take(x, idx).asnumpy()
+    np.testing.assert_allclose(got, [3.0, N - 2.0, float(1 << 25)])
+
+
+def test_large_argmax_at_far_offset():
+    x = nd.zeros((N,))
+    # argmax returns float32 indices (the reference's convention), which
+    # cannot represent every integer past 2**24 — plant at a
+    # representable offset for the argmax check...
+    representable = (N - 7) & ~7
+    x[representable] = 7.0
+    assert int(nd.argmax(x, axis=0).asscalar()) == representable
+    # ...and use topk's dtype='int32' escape hatch for EXACT indices at
+    # arbitrary large offsets (this is what large-index code must use)
+    x2 = nd.zeros((N,))
+    awkward = N - 3          # not fp32-representable
+    x2[awkward] = 7.0
+    got = nd.topk(x2, k=1, ret_typ="indices", dtype="int32")
+    assert int(np.asarray(got.asnumpy()).ravel()[0]) == awkward
+
+
+def test_large_tall_matmul_and_reshape():
+    rows, cols = TALL
+    a = nd.ones((rows, cols))
+    w = nd.array(np.arange(cols, dtype="f").reshape(cols, 1))
+    out = nd.dot(a, w)
+    assert out.shape == (rows, 1)
+    expect = float(np.arange(cols).sum())
+    got = out[rows - 1, 0].asscalar()
+    assert abs(float(got) - expect) < 1e-3
+    r = a.reshape((rows // 4, cols * 4))
+    assert r.shape == (rows // 4, cols * 4)
+    assert float(r[rows // 4 - 1, -1].asscalar()) == 1.0

@@ -1,0 +1,57 @@
+"""Model backwards-compatibility lane (reference:
+model_backwards_compatibility_check/ — SURVEY.md §5 nightly tier).
+
+The committed bc_fixtures/v1 artifacts were written by
+tools/gen_bc_fixtures.py at format version 1; every future framework
+version must keep loading them bit-compatibly through BOTH persistence
+paths (deploy symbol+checkpoint and gluon save_parameters) and reproduce
+the recorded outputs."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "bc_fixtures", "v1")
+
+
+def _manifest():
+    with open(os.path.join(FIX, "manifest.json")) as f:
+        return json.load(f)
+
+
+@pytest.mark.parametrize("name", sorted(_manifest()["models"]))
+def test_deploy_format_loads_and_reproduces(name):
+    m = _manifest()["models"][name]
+    x = np.load(os.path.join(FIX, m["input"]))
+    expected = np.load(os.path.join(FIX, m["expected"]))
+    net = gluon.SymbolBlock.imports(
+        os.path.join(FIX, f"{name}-symbol.json"), ["data"],
+        os.path.join(FIX, f"{name}-0000.params"))
+    got = net(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", sorted(_manifest()["models"]))
+def test_module_checkpoint_loads(name):
+    from mxnet_tpu.module.module import load_checkpoint
+
+    sym, arg, aux = load_checkpoint(os.path.join(FIX, name), 0)
+    assert sym.list_arguments()
+    assert arg and all(hasattr(v, "shape") for v in arg.values())
+
+
+def test_gluon_params_format_loads_and_reproduces():
+    m = _manifest()["models"]["mlp"]
+    x = np.load(os.path.join(FIX, m["input"]))
+    expected = np.load(os.path.join(FIX, m["expected"]))
+    net = gluon.nn.HybridSequential(prefix="bcmlp_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.load_parameters(os.path.join(FIX, "mlp.gluon.params"))
+    got = net(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)

@@ -82,3 +82,20 @@ def test_image_record_dataset(tmp_path):
     img, label = ds[2]
     assert label == 2.0
     assert (img == 2).all()
+
+
+def test_zero_dim_array_roundtrips_exactly():
+    """0-d arrays round-trip through save/load keeping shape () (review
+    finding r5: ascontiguousarray promoted them to (1,) at save, and
+    nd.array's legacy scalar promotion would re-break them at load)."""
+    z = mx.np.array(2.5)
+    assert z.shape == ()
+    import os
+    import tempfile
+
+    path = os.path.join(tempfile.mkdtemp(), "z.params")
+    mx.nd.save(path, {"s": z, "v": mx.nd.array([1.0, 2.0])})
+    back = mx.nd.load(path)
+    assert back["s"].shape == ()
+    assert float(back["s"].asscalar()) == 2.5
+    assert back["v"].shape == (2,)

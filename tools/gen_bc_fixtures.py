@@ -1,0 +1,66 @@
+"""Generate model backwards-compatibility fixtures (reference:
+model_backwards_compatibility_check/ — SURVEY.md §5 nightly tier).
+
+Run ONCE per format version; the committed fixtures pin today's .params /
+symbol-JSON wire formats so future framework versions must keep loading
+them (tests/nightly/test_model_backwards_compat.py enforces it)."""
+import json
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))), "tests", "nightly", "bc_fixtures", "v1")
+
+
+def build_mlp():
+    net = gluon.nn.HybridSequential(prefix="bcmlp_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu"),
+                gluon.nn.Dense(3))
+    return net, np.linspace(-1, 1, 2 * 5).reshape(2, 5).astype("f")
+
+
+def build_conv():
+    net = gluon.nn.HybridSequential(prefix="bcconv_")
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(4, 3, padding=1, activation="relu"),
+                gluon.nn.BatchNorm(),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(2))
+    return net, np.linspace(-1, 1, 1 * 3 * 8 * 8).reshape(
+        1, 3, 8, 8).astype("f")
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    mx.random.seed(0)   # reproducible: re-running regenerates bitwise
+    manifest = {}
+    for name, (net, x) in {"mlp": build_mlp(), "conv": build_conv()}.items():
+        net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2))
+        net.hybridize()
+        y = net(nd.array(x))
+        base = os.path.join(OUT, name)
+        # deploy format: symbol JSON + Module-checkpoint params
+        net.export(base, 0, nd.array(x))
+        # gluon format: save_parameters
+        net.save_parameters(base + ".gluon.params")
+        np.save(base + ".input.npy", x)
+        np.save(base + ".expected.npy", y.asnumpy())
+        manifest[name] = {"input": name + ".input.npy",
+                          "expected": name + ".expected.npy"}
+    with open(os.path.join(OUT, "manifest.json"), "w") as f:
+        json.dump({"format_version": 1, "models": manifest}, f, indent=1)
+    print("fixtures written to", OUT)
+
+
+if __name__ == "__main__":
+    main()
