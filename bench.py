@@ -70,31 +70,52 @@ def bench_resnet50(on_tpu):
     # NHWC: XLA:TPU tiles channel-last convs onto the MXU without the
     # internal relayout transposes logical-NCHW convs pay (override with
     # MXNET_BENCH_LAYOUT=NCHW to A/B the layouts on the chip).  The
-    # headline must survive either layout failing, so fall back.
+    # headline must survive any config failing, so fall back per config.
+    #
+    # Escalation sweep (PERF_NOTES: if plain NHWC lands under MFU 0.35):
+    # on TPU the bench ALSO measures batch-512+remat and the
+    # space-to-depth stem unattended, reports each in extras, and
+    # headlines the best — one wedged-tunnel round must not leave the
+    # escalation unmeasured again.  MXNET_BENCH_SWEEP=0 pins the single
+    # default config.
     import os
+    import sys
 
     layout = os.environ.get("MXNET_BENCH_LAYOUT", "NHWC")
-    try:
-        return _bench_resnet50_layout(on_tpu, layout)
-    except Exception as e:
-        if layout == "NCHW":
-            raise
-        import sys
-
-        print(f"bench: {layout} resnet path failed ({e!r}); falling back "
-              "to NCHW — the headline now measures the NCHW layout",
+    sweep = os.environ.get("MXNET_BENCH_SWEEP", "1") != "0"
+    configs = [("base", layout, None, False, "conv7")]
+    if on_tpu and sweep and layout == "NHWC":
+        configs += [("b512_remat", layout, 512, True, "conv7"),
+                    ("b512_remat_s2d", layout, 512, True, "s2d")]
+    results = {}
+    for name, lay, batch, remat, stem in configs:
+        try:
+            results[name] = _bench_resnet50_layout(
+                on_tpu, lay, batch=batch, remat=remat, stem=stem)
+        except Exception as e:
+            print(f"bench: resnet config {name} failed ({e!r})",
+                  file=sys.stderr)
+            results[name] = None
+    if results.get("base") is None and layout != "NCHW":
+        print("bench: NHWC resnet failed; headline falls back to NCHW",
               file=sys.stderr)
-        return _bench_resnet50_layout(on_tpu, "NCHW")
+        results["base"] = _bench_resnet50_layout(on_tpu, "NCHW")
+    ok = {k: v for k, v in results.items() if v is not None}
+    best = max(ok, key=lambda k: ok[k][0])
+    extras = {k: {"value": round(v[0], 2), "mfu": round(v[1], 4)}
+              for k, v in ok.items()}
+    return ok[best] + ({"configs": extras, "best": best},)
 
 
-def _bench_resnet50_layout(on_tpu, layout):
+def _bench_resnet50_layout(on_tpu, layout, batch=None, remat=False,
+                           stem="conv7"):
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.parallel.data_parallel import TrainStep
 
-    batch = 256 if on_tpu else 16
+    batch = batch or (256 if on_tpu else 16)
     size = 224 if on_tpu else 64
-    net = vision.resnet50_v1(layout=layout)
+    net = vision.resnet50_v1(layout=layout, stem=stem)
     net.initialize(ctx=mx.current_context())
     dshape = (1, size, size, 3) if layout == "NHWC" else (1, 3, size, size)
     net(mx.nd.zeros(dshape))  # settle deferred param shapes
@@ -108,7 +129,7 @@ def _bench_resnet50_layout(on_tpu, layout):
 
     step = TrainStep(net, loss_fn, optimizer="sgd",
                      optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-                     train_mode=True, dtype="bfloat16")
+                     train_mode=True, dtype="bfloat16", remat=remat)
 
     xshape = (batch, size, size, 3) if layout == "NHWC" else \
         (batch, 3, size, size)
@@ -257,8 +278,8 @@ def main():
         import jax
 
         on_tpu = jax.default_backend() == "tpu"
-    img_s, resnet_mfu = bench_resnet50(on_tpu)
-    extra = {}
+    img_s, resnet_mfu, resnet_cfgs = bench_resnet50(on_tpu)
+    extra = {"resnet_configs": resnet_cfgs}
     try:
         bert_s, bert_mfu = bench_bert(on_tpu)
         extra["bert_base_pretrain"] = {

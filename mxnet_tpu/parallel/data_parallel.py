@@ -129,7 +129,7 @@ class TrainStep:
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, param_sharding="replicated", extra_param_specs=None,
                  batch_axes=("dp", "fsdp"), donate=True, train_mode=True,
-                 dtype=None, pipeline=None):
+                 dtype=None, pipeline=None, remat=False):
         """``pipeline``: dict enabling pipeline parallelism over a mesh
         axis — {'num_microbatches': M, 'axis': 'pp', 'schedule':
         'gpipe'|'1f1b', 'remat_stage': bool}.  The net must implement
@@ -144,6 +144,19 @@ class TrainStep:
         self._net = net
         apply_fn, params = functionalize(net, train_mode=train_mode,
                                          with_state=train_mode)
+        if remat:
+            # whole-model rematerialization: backward recomputes the
+            # forward instead of storing activations — the standard lever
+            # for 2x batch (PERF_NOTES escalation step 2).  Models with
+            # finer-grained remat (Llama's per-layer checkpoint) should
+            # use their own option instead.
+            base_apply = apply_fn
+
+            def apply_fn(p, rng, *args):
+                import jax as _jx
+
+                return _jx.checkpoint(
+                    lambda pp, aa: base_apply(pp, rng, *aa))(p, args)
         self._apply_fn = apply_fn
         self._with_state = train_mode
         self._pipeline = None
