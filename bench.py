@@ -88,6 +88,7 @@ def bench_resnet50(on_tpu):
         configs += [("b512_remat", layout, 512, True, "conv7"),
                     ("b512_remat_s2d", layout, 512, True, "s2d")]
     results = {}
+    last_exc = None
     for name, lay, batch, remat, stem in configs:
         try:
             results[name] = _bench_resnet50_layout(
@@ -96,11 +97,15 @@ def bench_resnet50(on_tpu):
             print(f"bench: resnet config {name} failed ({e!r})",
                   file=sys.stderr)
             results[name] = None
-    if results.get("base") is None and layout != "NCHW":
-        print("bench: NHWC resnet failed; headline falls back to NCHW",
-              file=sys.stderr)
-        results["base"] = _bench_resnet50_layout(on_tpu, "NCHW")
+            last_exc = e
     ok = {k: v for k, v in results.items() if v is not None}
+    if not ok and layout != "NCHW":
+        # every NHWC config failed: one last try on the old layout
+        print("bench: all NHWC configs failed; falling back to NCHW",
+              file=sys.stderr)
+        ok["base_nchw"] = _bench_resnet50_layout(on_tpu, "NCHW")
+    if not ok:
+        raise last_exc  # surfaced as the parseable error JSON in main()
     best = max(ok, key=lambda k: ok[k][0])
     extras = {k: {"value": round(v[0], 2), "mfu": round(v[1], 4)}
               for k, v in ok.items()}

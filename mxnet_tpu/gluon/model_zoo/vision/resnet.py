@@ -174,14 +174,14 @@ class _SpaceToDepthInput(HybridBlock):
 
     def hybrid_forward(self, F, x):
         if self._cl:
+            # (bh, bw, c) channel interleave — the same ordering the
+            # registered space_to_depth op emits, so the NCHW<->NHWC
+            # stem-weight remap stays the standard OIHW<->OHWI transpose
             n, h, w, c = x.shape
             x = F.reshape(x, shape=(n, h // 2, 2, w // 2, 2, c))
             x = F.transpose(x, axes=(0, 1, 3, 2, 4, 5))
             return F.reshape(x, shape=(n, h // 2, w // 2, 4 * c))
-        n, c, h, w = x.shape
-        x = F.reshape(x, shape=(n, c, h // 2, 2, w // 2, 2))
-        x = F.transpose(x, axes=(0, 1, 3, 5, 2, 4))
-        return F.reshape(x, shape=(n, 4 * c, h // 2, w // 2))
+        return F.space_to_depth(x, block_size=2)
 
 
 class ResNetV1(HybridBlock):

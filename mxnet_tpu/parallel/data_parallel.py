@@ -141,6 +141,11 @@ class TrainStep:
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        if remat and pipeline is not None:
+            raise MXNetError(
+                "TrainStep(remat=True) does not compose with pipeline=; "
+                "use pipeline={'remat_stage': True} for per-stage "
+                "rematerialization inside the pipe")
         self._net = net
         apply_fn, params = functionalize(net, train_mode=train_mode,
                                          with_state=train_mode)

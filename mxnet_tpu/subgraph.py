@@ -24,7 +24,8 @@ from .ops.registry import OP_TABLE, register
 from .symbol.symbol import Symbol, _Node, _topo
 
 __all__ = ["register_backend", "register_pass", "list_backends",
-           "optimize_for", "clone", "fuse_linear_chain"]
+           "optimize_for", "clone", "fuse_linear_chain",
+           "SubgraphProperty", "partition_graph"]
 
 _BACKENDS = {}
 
@@ -167,6 +168,211 @@ def fuse_linear_chain(sym, pattern, fused_op, make_attrs=None):
         n.inputs = [(replaced.get(id(i), i), idx) for i, idx in n.inputs]
     sym._heads = [(replaced.get(id(n), n), i) for n, i in sym._heads]
     return sym
+
+
+# --------------------------------------------------------------------------
+# property-based partitioning over typed selectors
+# (reference: subgraph_property.h SubgraphProperty/SubgraphSelector —
+# Select/SelectInput/SelectOutput growing arbitrary connected regions,
+# not just linear chains)
+# --------------------------------------------------------------------------
+class SubgraphProperty:
+    """Typed-selector partitioning rules.  Subclass and override:
+
+    - ``select(node)``: may this node SEED a region?
+    - ``select_input(node, producer)``: grow the region upstream from
+      ``node`` to ``producer``?
+    - ``select_output(node, consumer)``: grow downstream?
+    - ``min_size``: discard regions smaller than this (default 2 — a
+      1-node region is not worth a dispatch).
+
+    ``partition_graph(sym, prop)`` greedily grows maximal regions, then
+    replaces each with ONE dynamically-registered op that interprets the
+    captured region through the same registry kernels (one dispatch per
+    region on the eager Executor, one tape entry under autograd; XLA sees
+    the identical fused computation under jit)."""
+
+    min_size = 2
+
+    def select(self, node):
+        return False
+
+    def select_input(self, node, producer):
+        return self.select(producer)
+
+    def select_output(self, node, consumer):
+        return self.select(consumer)
+
+    def op_name(self, nodes):
+        return "_sg_region"
+
+
+_REGION_COUNTER = [0]
+
+
+_REGION_CACHE = {}
+
+
+def _make_region_op(region_nodes, ext_inputs, out_node, name_hint):
+    """Register (or reuse) an op executing the captured region: inputs
+    are the region's external feeds — (producer, out_idx) EDGES, already
+    indexed by the executor — output the region's single result.  The op
+    body re-runs each captured node's registry kernel: pure, traceable,
+    differentiable.  Structurally identical regions share one registered
+    op (repeated bind-time partitioning must not grow OP_TABLE)."""
+    plan = []  # (op_name, attrs, [(src_kind, key, out_idx|None), ...])
+    index_of = {id(n): i for i, n in enumerate(region_nodes)}
+    ext_index = {(id(n), idx): i for i, (n, idx) in enumerate(ext_inputs)}
+    from .symbol.symbol import _clean_attrs
+
+    for n in region_nodes:
+        srcs = []
+        for inp, idx in n.inputs:
+            if id(inp) in index_of:
+                srcs.append(("node", index_of[id(inp)], idx))
+            else:
+                srcs.append(("ext", ext_index[(id(inp), idx)], None))
+        plan.append((n.op, _clean_attrs(n.attrs), srcs))
+    out_pos = index_of[id(out_node)]
+
+    sig = (name_hint, out_pos,
+           tuple((op, tuple(sorted((k, repr(v)) for k, v in at.items())),
+                  tuple(srcs)) for op, at, srcs in plan))
+    if sig in _REGION_CACHE:
+        return _REGION_CACHE[sig]
+    _REGION_COUNTER[0] += 1
+    opname = f"{name_hint}{_REGION_COUNTER[0]}"
+    fns = [OP_TABLE[op].fn for op, _, _ in plan]
+
+    def region_fn(*ext_vals):
+        vals = []
+        for fn, (_, attrs, srcs) in zip(fns, plan):
+            args = []
+            for kind, key, idx in srcs:
+                if kind == "ext":
+                    args.append(ext_vals[key])  # executor pre-indexed
+                else:
+                    v = vals[key]
+                    args.append(v[idx] if isinstance(v, (tuple, list))
+                                else v)
+            vals.append(fn(*args, **attrs))
+        out = vals[out_pos]
+        return out[0] if isinstance(out, (tuple, list)) else out
+
+    region_fn.__name__ = opname
+    register(opname)(region_fn)
+    _REGION_CACHE[sig] = opname
+    return opname
+
+
+def partition_graph(sym, prop):
+    """Partition ``sym`` with a :class:`SubgraphProperty`; returns a new
+    Symbol with each maximal selected region collapsed to one node
+    (reference: BuildSubgraph over SubgraphSelector decisions).  Regions
+    are constrained to a single output node (multi-consumer interior
+    nodes stay internal only if every consumer is in the region)."""
+    out_sym, _ = clone(sym)
+    nodes = _topo(out_sym._heads)
+    consumers = {}
+    for n in nodes:
+        for inp, _ in n.inputs:
+            consumers.setdefault(id(inp), []).append(n)
+    head_ids = {id(n) for n, _ in out_sym._heads}
+
+    def _fusable(n):
+        # rng-consuming ops take an injected key the region replay cannot
+        # thread, and the executor's training/state injection (BatchNorm
+        # moving stats, Dropout train flag, RNN) keys on the ORIGINAL op
+        # name — fusing them would silently freeze training semantics.
+        # Multi-output ops are fine in the region INTERIOR (indexed
+        # positionally); the single-output boundary is enforced below.
+        od = OP_TABLE.get(n.op)
+        return od is not None and not od.needs_rng and \
+            n.op not in ("BatchNorm", "Dropout", "RNN")
+
+    assigned = set()
+    regions = []
+    for seed in nodes:
+        if seed.op is None or id(seed) in assigned or \
+                not _fusable(seed) or not prop.select(seed):
+            continue
+        region = {id(seed): seed}
+        frontier = [seed]
+        while frontier:
+            cur = frontier.pop()
+            for inp, _ in cur.inputs:
+                if inp.op is None or id(inp) in assigned or \
+                        id(inp) in region:
+                    continue
+                if _fusable(inp) and prop.select_input(cur, inp):
+                    region[id(inp)] = inp
+                    frontier.append(inp)
+            for con in consumers.get(id(cur), []):
+                if con.op is None or id(con) in assigned or \
+                        id(con) in region:
+                    continue
+                if _fusable(con) and prop.select_output(cur, con):
+                    region[id(con)] = con
+                    frontier.append(con)
+        # shrink until the region has exactly ONE single-output output
+        # node (a node with a consumer outside the region, or a head).
+        # The single-output constraint also guarantees acyclicity of the
+        # collapsed graph: every region node feeds (transitively) into
+        # the unique output, so an external path re-entering the region
+        # would have to both depend on and feed the output — a cycle in
+        # the ORIGINAL DAG, which cannot exist.
+        while True:
+            outs = [n for n in region.values()
+                    if id(n) in head_ids or any(
+                        id(c) not in region
+                        for c in consumers.get(id(n), []))]
+            multi = [n for n in outs if n.nout != 1]
+            if multi:
+                # a multi-output boundary cannot collapse to a 1-output
+                # fused node; push it (and its extra outputs) outside
+                del region[id(multi[0])]
+            elif len(outs) > 1:
+                # drop the topologically-earliest extra output
+                drop = min(outs, key=lambda n: nodes.index(n))
+                del region[id(drop)]
+            else:
+                break
+        if len(region) < prop.min_size or not region:
+            continue
+        ordered = [n for n in nodes if id(n) in region]
+        out_node = [n for n in ordered
+                    if id(n) in head_ids or any(
+                        id(c) not in region
+                        for c in consumers.get(id(n), []))]
+        out_node = out_node[0] if out_node else ordered[-1]
+        regions.append((ordered, region, out_node))
+        assigned.update(region)
+
+    if not regions:
+        return out_sym
+    for ordered, region, out_node in regions:
+        # external feeds are EDGES (producer, out_idx): two consumptions
+        # of different outputs of one producer are distinct inputs
+        ext = []
+        seen_ext = set()
+        for n in ordered:
+            for inp, idx in n.inputs:
+                if id(inp) not in region and (id(inp), idx) not in seen_ext:
+                    seen_ext.add((id(inp), idx))
+                    ext.append((inp, idx))
+        opname = _make_region_op(ordered, ext, out_node,
+                                 prop.op_name(ordered))
+        fused = _Node(opname, f"{out_node.name}_region",
+                      {"__n_fused__": len(ordered)},
+                      list(ext), 1, None)
+        replaced = {id(out_node): fused}
+        for n in _topo(out_sym._heads):
+            if id(n) not in region:
+                n.inputs = [(replaced.get(id(i), i), idx)
+                            for i, idx in n.inputs]
+        out_sym._heads = [(replaced.get(id(n), n), i)
+                          for n, i in out_sym._heads]
+    return out_sym
 
 
 # --------------------------------------------------------------------------

