@@ -192,6 +192,47 @@ def bench_bert(on_tpu):
 
 
 def bench_llama(on_tpu):
+    """On TPU (and unless MXNET_BENCH_SWEEP=0) this sweeps flash-attention
+    block sizes — the tune PERF_NOTES flagged as needing a chip run — and
+    headlines the best (block config reported in extras)."""
+    import os
+    import sys
+
+    sweep = os.environ.get("MXNET_BENCH_SWEEP", "1") != "0"
+    explicit = ("MXNET_FLASH_BLOCK_Q" in os.environ
+                or "MXNET_FLASH_BLOCK_KV" in os.environ)
+    if explicit:
+        # user pinned a config: measure EXACTLY that, touch nothing
+        bq = int(os.environ.get("MXNET_FLASH_BLOCK_Q", 128))
+        bkv = int(os.environ.get("MXNET_FLASH_BLOCK_KV", 128))
+        tok, mfu = _bench_llama_once(on_tpu)
+        key = f"q{bq}_kv{bkv}"
+        return tok, mfu, {"flash_blocks": {key: {
+            "value": round(tok, 2), "mfu": round(mfu, 4)}}, "best": key}
+    grid = [(128, 128)]
+    if on_tpu and sweep:
+        grid += [(256, 256), (256, 512), (512, 512)]
+    results = {}
+    for bq, bkv in grid:
+        os.environ["MXNET_FLASH_BLOCK_Q"] = str(bq)
+        os.environ["MXNET_FLASH_BLOCK_KV"] = str(bkv)
+        try:
+            results[f"q{bq}_kv{bkv}"] = _bench_llama_once(on_tpu)
+        except Exception as e:
+            print(f"bench: llama blocks ({bq},{bkv}) failed ({e!r})",
+                  file=sys.stderr)
+    os.environ.pop("MXNET_FLASH_BLOCK_Q", None)
+    os.environ.pop("MXNET_FLASH_BLOCK_KV", None)
+    if not results:
+        raise RuntimeError("all llama flash-block configs failed")
+    best = max(results, key=lambda k: results[k][0])
+    tok, mfu = results[best]
+    cfgs = {k: {"value": round(v[0], 2), "mfu": round(v[1], 4)}
+            for k, v in results.items()}
+    return tok, mfu, {"flash_blocks": cfgs, "best": best}
+
+
+def _bench_llama_once(on_tpu):
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo.language import llama
     from mxnet_tpu.parallel.data_parallel import TrainStep
@@ -293,10 +334,10 @@ def main():
     except Exception as e:  # keep the headline alive
         extra["bert_base_pretrain"] = {"error": repr(e)[:200]}
     try:
-        llama_s, llama_mfu = bench_llama(on_tpu)
+        llama_s, llama_mfu, llama_cfgs = bench_llama(on_tpu)
         extra["llama_proxy_train"] = {
             "value": round(llama_s, 2), "unit": "tokens/s/chip",
-            "mfu": round(llama_mfu, 4)}
+            "mfu": round(llama_mfu, 4), **llama_cfgs}
     except Exception as e:
         extra["llama_proxy_train"] = {"error": repr(e)[:200]}
     try:

@@ -273,6 +273,7 @@ def partition_graph(sym, prop):
     nodes stay internal only if every consumer is in the region)."""
     out_sym, _ = clone(sym)
     nodes = _topo(out_sym._heads)
+    order = {id(n): i for i, n in enumerate(nodes)}
     consumers = {}
     for n in nodes:
         for inp, _ in n.inputs:
@@ -333,7 +334,7 @@ def partition_graph(sym, prop):
                 del region[id(multi[0])]
             elif len(outs) > 1:
                 # drop the topologically-earliest extra output
-                drop = min(outs, key=lambda n: nodes.index(n))
+                drop = min(outs, key=lambda n: order[id(n)])
                 del region[id(drop)]
             else:
                 break

@@ -89,11 +89,18 @@ def test_zero_size_arrays():
 
 
 def test_scalar_and_rank1_shapes():
+    # legacy nd semantics (reference): scalars become shape (1,) unless
+    # npx.set_np(shape=True) is active; mx.np keeps native zero-dim
     s = nd.array(3.5)
-    assert s.shape == ()
+    assert s.shape == (1,)
     assert float((s * 2).asscalar()) == 7.0
     v = nd.ones((1,))
     assert (v + s).shape == (1,)
+    mx.npx.set_np(shape=True, array=False)
+    try:
+        assert nd.array(3.5).shape == ()
+    finally:
+        mx.npx.reset_np()
 
 
 def test_prime_and_highrank_shapes():
