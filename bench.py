@@ -212,7 +212,8 @@ def bench_llama(on_tpu):
     grid = [(128, 128)]
     if on_tpu and sweep:
         grid += [(256, 256), (256, 512), (512, 512)]
-    results = {}
+    results, errors = {}, {}
+    last_exc = None
     for bq, bkv in grid:
         os.environ["MXNET_FLASH_BLOCK_Q"] = str(bq)
         os.environ["MXNET_FLASH_BLOCK_KV"] = str(bkv)
@@ -221,14 +222,19 @@ def bench_llama(on_tpu):
         except Exception as e:
             print(f"bench: llama blocks ({bq},{bkv}) failed ({e!r})",
                   file=sys.stderr)
+            errors[f"q{bq}_kv{bkv}"] = repr(e)[:200]
+            last_exc = e
     os.environ.pop("MXNET_FLASH_BLOCK_Q", None)
     os.environ.pop("MXNET_FLASH_BLOCK_KV", None)
     if not results:
-        raise RuntimeError("all llama flash-block configs failed")
+        raise last_exc  # the real root cause reaches BENCH.json's error
     best = max(results, key=lambda k: results[k][0])
     tok, mfu = results[best]
     cfgs = {k: {"value": round(v[0], 2), "mfu": round(v[1], 4)}
             for k, v in results.items()}
+    # failed configs stay visible, distinguishable from never-swept ones
+    for k, err in errors.items():
+        cfgs[k] = {"error": err}
     return tok, mfu, {"flash_blocks": cfgs, "best": best}
 
 

@@ -342,3 +342,85 @@ def test_low_precision_dot_consistency(dt, tol):
 
     np.testing.assert_allclose(run(mx.cpu()), run(mx.tpu()),
                                rtol=tol, atol=tol)
+
+
+# ---- round-5 additions: new op surface must hold on the chip ----------
+@requires_tpu
+def test_deconvolution_nhwc_consistency():
+    x = _R.randn(1, 5, 5, 3).astype("f")
+    w = _R.randn(3, 3, 3, 4).astype("f")  # (in, kh, kw, out/g)
+    check_consistency("Deconvolution", [x, w],
+                      {"kernel": (3, 3), "stride": (2, 2),
+                       "num_filter": 4, "no_bias": True,
+                       "layout": "NHWC"},
+                      rtol=MATMUL_TOL, atol=1e-3)
+
+
+@requires_tpu
+def test_rnn_use_sequence_length_consistency():
+    from mxnet_tpu.ops.nn import rnn_param_size
+
+    T, N, C, H = 5, 3, 4, 6
+    x = _R.randn(T, N, C).astype("f") * 0.5
+    flat = _R.randn(rnn_param_size("lstm", C, H, bidirectional=True)
+                    ).astype("f") * 0.3
+    h0 = np.zeros((2, N, H), "f")
+    c0 = np.zeros((2, N, H), "f")
+    lens = np.array([5, 3, 1], "f")
+    check_consistency("RNN", [x, flat, h0, c0, lens],
+                      {"state_size": H, "mode": "lstm",
+                       "bidirectional": True,
+                       "use_sequence_length": True},
+                      rtol=TRANSCENDENTAL_TOL, atol=TRANSCENDENTAL_TOL)
+
+
+@requires_tpu
+def test_correlation_consistency():
+    a = _R.randn(1, 2, 8, 8).astype("f")
+    b = _R.randn(1, 2, 8, 8).astype("f")
+    check_consistency("Correlation", [a, b],
+                      {"kernel_size": 3, "max_displacement": 2,
+                       "pad_size": 3}, rtol=MATMUL_TOL, atol=1e-4)
+
+
+@requires_tpu
+def test_pdf_ops_consistency():
+    s = _R.uniform(0.2, 2.0, (2, 5)).astype("f")
+    check_consistency("_random_pdf_gamma",
+                      [s, np.array([2.0], "f"), np.array([1.5], "f")],
+                      rtol=TRANSCENDENTAL_TOL, atol=TRANSCENDENTAL_TOL)
+    check_consistency("_random_pdf_normal",
+                      [s, np.array([0.5], "f"), np.array([1.2], "f")],
+                      rtol=TRANSCENDENTAL_TOL, atol=TRANSCENDENTAL_TOL)
+
+
+@requires_tpu
+def test_s2d_stem_resnet_consistency():
+    """The space-to-depth stem variant forwards identically on chip."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10, layout="NHWC", stem="s2d")
+    net.initialize(ctx=mx.cpu())
+    x = mx.nd.array(_R.randn(2, 32, 32, 3).astype("f"))
+    y_cpu = net(x).asnumpy()
+    net_t = vision.resnet18_v1(classes=10, layout="NHWC", stem="s2d")
+    net_t.initialize(ctx=mx.tpu())
+    # construction order is the stable cross-instance correspondence
+    # (names carry differing global layer counters)
+    for q, p in zip(net_t.collect_params().values(),
+                    net.collect_params().values()):
+        q.set_data(mx.nd.array(p.data().asnumpy(), ctx=mx.tpu()))
+    y_tpu = net_t(mx.nd.array(x.asnumpy(), ctx=mx.tpu())).asnumpy()
+    np.testing.assert_allclose(y_tpu, y_cpu, rtol=MATMUL_TOL, atol=1e-2)
+
+
+@requires_tpu
+def test_moe_swiglu_consistency():
+    x = _R.randn(1, 6, 8).astype("f")
+    router = _R.randn(8, 2).astype("f")
+    g = _R.randn(2, 8, 12).astype("f") * 0.3
+    u = _R.randn(2, 8, 12).astype("f") * 0.3
+    d = _R.randn(2, 12, 8).astype("f") * 0.3
+    check_consistency("_contrib_moe_swiglu", [x, router, g, u, d],
+                      {"capacity_factor": 4.0},
+                      rtol=MATMUL_TOL, atol=1e-3)
