@@ -128,6 +128,84 @@ class BertForPretraining(HybridBlock):
         mlm = self.mlm_decoder(self.mlm_norm(F.gelu(self.mlm_dense(seq))))
         return mlm, self.nsp(pooled)
 
+    def pipeline_decompose(self, n_stages, train_mode=True):
+        """Split BertForPretraining for TrainStep(pipeline=...): embeddings
+        (pre) -> n_stages uniform encoder stages -> pooler + MLM/NSP heads
+        (post).  Same contract as LlamaForCausalLM.pipeline_decompose.
+
+        Notes: token_types input is not threaded (the bench/pretrain path
+        passes ids only).  Dropout keys differ from the monolithic trace:
+        the pipelined trunk folds (stage, layer) into the key — distinct
+        masks per layer, shared across microbatches (the 1F1B recompute
+        must reproduce forward masks exactly) — so use dropout=0 when
+        bit-matching trajectories against the plain path.
+        """
+        from ....base import MXNetError
+        from ....ops.registry import OP_TABLE
+        from ....parallel.functional import functionalize
+
+        cfg = self._cfg
+        L = cfg.num_layers
+        if L % n_stages:
+            raise MXNetError(
+                f"num_layers {L} not divisible by pipeline stages {n_stages}")
+        bert = self.bert
+        f = lambda blk: functionalize(blk, train_mode=train_mode)
+        we, we_p = f(bert.word_embed)
+        pe, pe_p = f(bert.position_embed)
+        en, en_p = f(bert.embed_norm)
+        do, do_p = f(bert.embed_dropout)
+        lay0 = bert.encoder[0]
+        lay, lay0_p = f(lay0)
+        po, po_p = f(bert.pooler)
+        md, md_p = f(self.mlm_dense)
+        mn, mn_p = f(self.mlm_norm)
+        mdec, mdec_p = f(self.mlm_decoder)
+        nsp, nsp_p = f(self.nsp)
+        gelu = OP_TABLE["gelu"].fn
+
+        # construction-order mapping: identical blocks declare parameters in
+        # the same order, while auto-generated name prefixes (dense7_, ...)
+        # differ per instance — positional zip is the stable correspondence
+        lay0_order = list(lay0.collect_params())
+        layer_names = []
+        for i in range(L):
+            blk_order = list(bert.encoder[i].collect_params())
+            layer_names.append(dict(zip(lay0_order, blk_order,
+                                        strict=True)))
+
+        def pre_fn(psub, rng, ids):
+            import jax.numpy as jnp
+
+            l = ids.shape[1]
+            h = we({k: psub[k] for k in we_p}, rng, ids)
+            pos = pe({k: psub[k] for k in pe_p}, rng,
+                     jnp.arange(l, dtype=jnp.int32))
+            h = h + pos.reshape((1, l, -1))
+            h = en({k: psub[k] for k in en_p}, rng, h)
+            return do({k: psub[k] for k in do_p}, rng, h)
+
+        def layer_fn(pl, rng, h):
+            return lay(pl, rng, h)
+
+        def post_fn(psub, rng, h):
+            pooled = po({k: psub[k] for k in po_p}, rng, h[:, 0, :])
+            mlm = md({k: psub[k] for k in md_p}, rng, h)
+            mlm = mn({k: psub[k] for k in mn_p}, rng, gelu(mlm))
+            mlm = mdec({k: psub[k] for k in mdec_p}, rng, mlm)
+            return mlm, nsp({k: psub[k] for k in nsp_p}, rng, pooled)
+
+        return {
+            "pre_names": list(we_p) + list(pe_p) + list(en_p) + list(do_p),
+            "post_names": (list(po_p) + list(md_p) + list(mn_p)
+                           + list(mdec_p) + list(nsp_p)),
+            "layer_names": layer_names,
+            "layer0_names": list(lay0_p),
+            "pre_fn": pre_fn,
+            "layer_fn": layer_fn,
+            "post_fn": post_fn,
+        }
+
 
 def bert_base(**overrides):
     return BertModel(BertConfig(**overrides))
@@ -145,3 +223,5 @@ def bert_tiny(**overrides):
               intermediate_size=128, max_position=128)
     kw.update(overrides)
     return BertModel(BertConfig(**kw))
+
+

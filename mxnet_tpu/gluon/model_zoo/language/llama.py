@@ -284,13 +284,15 @@ class LlamaForCausalLM(HybridBlock):
                                            train_mode=train_mode)
         head_apply, head_p = functionalize(self.lm_head,
                                            train_mode=train_mode)
+        # construction-order mapping: identical blocks declare parameters
+        # in the same order; positional zip is stable even when child
+        # blocks carry auto-generated (globally counted) name prefixes
+        lay0_order = list(lay0.collect_params())
         layer_names = []
         for i in range(L):
-            blk = model.layers[i]
-            rel = {name[len(blk.prefix):]: name
-                   for name in blk.collect_params()}
-            layer_names.append(
-                {k0: rel[k0[len(lay0.prefix):]] for k0 in lay0_p})
+            blk_order = list(model.layers[i].collect_params())
+            layer_names.append(dict(zip(lay0_order, blk_order,
+                                        strict=True)))
 
         def pre_fn(psub, rng, ids):
             return embed_apply(psub, rng, ids)

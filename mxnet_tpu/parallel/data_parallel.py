@@ -283,10 +283,23 @@ class TrainStep:
             stacked = stack_stage_params(stage_trees)
 
             def stage_fn(sp, h_mb):
-                def body(hh, pl):
-                    return d["layer_fn"](pl, rng, hh), None
+                # fold stage + layer indices into the key so every trunk
+                # layer draws DISTINCT dropout masks (a shared key would
+                # correlate all layers).  The key must not depend on the
+                # tick/microbatch: the 1F1B backward recomputes the stage
+                # from the stashed input and has to reproduce the exact
+                # forward masks.
+                s_idx = jax.lax.axis_index(pipeline_cfg["axis"])
+                s_rng = jax.random.fold_in(rng, s_idx)
+                n_layers = jax.tree_util.tree_leaves(sp)[0].shape[0]
 
-                out, _ = jax.lax.scan(body, h_mb, sp)
+                def body(hh, pl_li):
+                    pl, li = pl_li
+                    return d["layer_fn"](
+                        pl, jax.random.fold_in(s_rng, li), hh), None
+
+                out, _ = jax.lax.scan(
+                    body, h_mb, (sp, jnp.arange(n_layers)))
                 return out
 
             h = pipeline_apply(

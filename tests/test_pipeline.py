@@ -191,3 +191,54 @@ def test_pipeline_rejects_bad_configs():
         pipeline_apply(_stage_fn, good, x, mesh, 3)  # batch % M
     with pytest.raises(mx.MXNetError):
         pipeline_apply(_stage_fn, good, x, mesh, 2, schedule="2f2b")
+
+
+def test_bert_trainstep_pp_matches_dp_trajectory():
+    """BERT (the second LLM family) trains through TrainStep(pipeline=...)
+    with pp=2 matching the plain-dp trajectory (dropout=0 for exact
+    parity — pipelined and monolithic traces draw different masks)."""
+    from mxnet_tpu.gluon.model_zoo.language import bert
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    def make_net():
+        net = bert.BertForPretraining(bert.BertConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+            intermediate_size=64, max_position=32, dropout=0.0))
+        net.initialize(ctx=mx.cpu())
+        net(mx.nd.zeros((1, 8), dtype="int32"))
+        return net
+
+    def loss_fn(outs, labels):
+        mlm, nsp = outs
+        mlm_labels, nsp_labels = labels[:, :-1], labels[:, -1]
+        logp = jax.nn.log_softmax(mlm, axis=-1)
+        mlm_l = -jnp.take_along_axis(logp, mlm_labels[..., None], axis=-1)
+        nsp_logp = jax.nn.log_softmax(nsp, axis=-1)
+        nsp_l = -jnp.take_along_axis(nsp_logp, nsp_labels[:, None],
+                                     axis=-1)
+        return jnp.mean(mlm_l) + jnp.mean(nsp_l)
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 128, (8, 8)).astype("int32")
+    labels = np.concatenate(
+        [rs.randint(0, 128, (8, 8)), rs.randint(0, 2, (8, 1))],
+        axis=1).astype("int32")
+
+    net1 = make_net()
+    step1 = TrainStep(net1, loss_fn, optimizer="adam",
+                      optimizer_params={"learning_rate": 1e-3},
+                      mesh=_mesh(8, ("dp",)), batch_axes=("dp",))
+    w0 = [p.data().asnumpy() for p in net1.collect_params().values()]
+    ref = [float(np.asarray(step1(ids, labels))) for _ in range(3)]
+
+    net2 = make_net()
+    for p, v in zip(net2.collect_params().values(), w0):
+        p.set_data(mx.nd.array(v))
+    step2 = TrainStep(net2, loss_fn, optimizer="adam",
+                      optimizer_params={"learning_rate": 1e-3},
+                      mesh=_mesh(8, ("dp", "pp"), (4, 2)),
+                      batch_axes=("dp",),
+                      pipeline={"num_microbatches": 2,
+                                "schedule": "1f1b"})
+    losses = [float(np.asarray(step2(ids, labels))) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-5)
