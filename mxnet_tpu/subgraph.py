@@ -437,3 +437,31 @@ _symbol_mod._OP_PARAM_VARS["_sg_fused_conv_act"] = \
 # scripts that say optimize_for('MKLDNN') keep working
 register_backend("MKLDNN", _BACKENDS["default"])
 register_backend("ONEDNN", _BACKENDS["default"])
+
+
+class _ElemwiseIslands(SubgraphProperty):
+    """Built-in property: collapse connected elementwise islands into one
+    dispatch each (the op-graph-level analog of XLA's own elementwise
+    fusion, useful on the eager Executor where each node costs a Python
+    dispatch)."""
+
+    _OPS = {"Activation", "activation", "relu", "sigmoid", "tanh",
+            "softsign", "gelu", "exp", "log", "sqrt", "square", "abs",
+            "negative", "broadcast_add", "broadcast_sub", "broadcast_mul",
+            "broadcast_div", "broadcast_maximum", "broadcast_minimum",
+            "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+            # Symbol operator sugar emits *_scalar for python-number
+            # operands (x * 0.5 etc.) — without these every scalar op
+            # would split an island
+            "broadcast_add_scalar", "broadcast_sub_scalar",
+            "broadcast_mul_scalar", "broadcast_div_scalar",
+            "broadcast_maximum_scalar", "broadcast_minimum_scalar",
+            "broadcast_power_scalar", "clip"}
+
+    def select(self, node):
+        return node.op in self._OPS
+
+
+@register_pass("islands")
+def fuse_elemwise_islands(sym):
+    return partition_graph(sym, _ElemwiseIslands())

@@ -242,3 +242,24 @@ def test_bert_trainstep_pp_matches_dp_trajectory():
                                 "schedule": "1f1b"})
     losses = [float(np.asarray(step2(ids, labels))) for _ in range(3)]
     np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_llama_moe_trainstep_pp_trains():
+    """The MoE Llama variant (homogeneous MoE decoder layers) also trains
+    through TrainStep(pipeline=...) — loss finite and decreasing."""
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    net = _make_llama({"num_experts": 2, "intermediate_size": 32})
+    step = TrainStep(net, _lm_loss, optimizer="adam",
+                     optimizer_params={"learning_rate": 1e-3},
+                     mesh=_mesh(4, ("dp", "pp"), (2, 2)),
+                     batch_axes=("dp",),
+                     pipeline={"num_microbatches": 2,
+                               "schedule": "1f1b"})
+    rs = np.random.RandomState(3)
+    ids = rs.randint(0, 64, (4, 8)).astype("int32")
+    lbl = rs.randint(0, 64, (4, 8)).astype("int32")
+    l0 = float(np.asarray(step(ids, lbl)))
+    for _ in range(3):
+        l1 = float(np.asarray(step(ids, lbl)))
+    assert np.isfinite(l1) and l1 < l0

@@ -250,3 +250,46 @@ def test_property_partitioning_reuses_region_ops():
     for _ in range(5):
         partition_graph(build(), Elemwise())
     assert len(OP_TABLE) == before
+
+
+def test_islands_backend_via_optimize_for():
+    """The built-in 'islands' backend routes through the property-based
+    partitioner via the standard optimize_for entry point."""
+    import numpy as np
+
+    from mxnet_tpu import subgraph
+    from mxnet_tpu.symbol.symbol import _topo
+
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, mx.sym.var("w"), num_hidden=4,
+                              no_bias=True)
+    y = mx.sym.tanh(mx.sym.sigmoid(h) + mx.sym.relu(h))
+    part = subgraph.optimize_for(y, "islands")
+    ops = [n.op for n in _topo(part._heads) if n.op is not None]
+    assert any(op.startswith("_sg_region") for op in ops), ops
+    rs = np.random.RandomState(0)
+    feed = {"data": mx.nd.array(rs.randn(2, 3).astype("f")),
+            "w": mx.nd.array(rs.randn(4, 3).astype("f") * 0.5)}
+    a = y.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    b = part.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    np.testing.assert_allclose(b, a, rtol=1e-6)
+
+
+def test_islands_fuse_through_scalar_ops():
+    """Scalar operands (x * 0.5 etc. -> broadcast_*_scalar) stay inside
+    an island instead of splitting it (review finding r5)."""
+    import numpy as np
+
+    from mxnet_tpu import subgraph
+    from mxnet_tpu.symbol.symbol import _topo
+
+    x = mx.sym.var("x")
+    y = mx.sym.tanh(0.5 * mx.sym.sigmoid(x * 2.0) + 1.0)
+    part = subgraph.optimize_for(y, "islands")
+    ops = [n.op for n in _topo(part._heads) if n.op is not None]
+    assert ops and all(op.startswith("_sg_region") for op in ops), ops
+    rs = np.random.RandomState(0)
+    feed = {"x": mx.nd.array(rs.randn(2, 3).astype("f"))}
+    a = y.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    b = part.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    np.testing.assert_allclose(b, a, rtol=1e-6)
