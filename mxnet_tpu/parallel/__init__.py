@@ -12,7 +12,8 @@ north star requires (TP/FSDP/SP that MXNet 1.x never had):
                    "Automatic Cross-Replica Sharding of Weight Update")
 - tensor_parallel: Megatron-style column/row PartitionSpec rules
 - distributed:     multi-process bootstrap + sharded-optimizer updater
-- context_parallel: ring attention (sequence parallelism) via ppermute
+- context_parallel: ring attention (ppermute) + Ulysses all_to_all
+  sequence parallelism
 - pipeline_parallel: GPipe schedule over the pp axis (weight-stationary
                    stages, ppermute activation passing, differentiable)
 - expert_parallel: switch-MoE layer with GSPMD all_to_all over ep
@@ -24,12 +25,16 @@ from . import tensor_parallel
 from . import pipeline_parallel
 from . import expert_parallel
 from .mesh import make_mesh, get_default_mesh, set_default_mesh
-from .context_parallel import ring_attention, context_parallel_attention
+from .context_parallel import (ring_attention,
+                               context_parallel_attention,
+                               ulysses_attention,
+                               ulysses_context_parallel_attention)
 from .pipeline_parallel import pipeline_apply, stack_stage_params
 from .expert_parallel import moe_apply, stack_expert_params
 
 __all__ = ["mesh", "collectives", "distributed", "tensor_parallel",
            "make_mesh", "get_default_mesh", "set_default_mesh",
            "ring_attention", "context_parallel_attention",
+           "ulysses_attention", "ulysses_context_parallel_attention",
            "pipeline_parallel", "expert_parallel", "pipeline_apply",
            "stack_stage_params", "moe_apply", "stack_expert_params"]

@@ -14,7 +14,9 @@ from __future__ import annotations
 import functools
 import math
 
-__all__ = ["ring_attention", "context_parallel_attention"]
+__all__ = ["ring_attention", "context_parallel_attention",
+           "ulysses_attention",
+           "ulysses_context_parallel_attention"]
 
 
 def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
@@ -76,6 +78,62 @@ def context_parallel_attention(q, k, v, mesh, axis_name="sp", causal=False,
     spec = P(None, None, axis_name, None)
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal,
                            sm_scale=sm_scale)
+    sharded = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)
+    return sharded(q, k, v)
+
+
+def ulysses_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
+    """DeepSpeed-Ulysses-style sequence parallelism: all_to_all reshards
+    (heads-local, seq-full), full attention runs per head shard, a second
+    all_to_all restores (heads-full, seq-local).  Call INSIDE shard_map.
+
+    The complement of :func:`ring_attention` (PAPERS.md Ulysses): two
+    all_to_alls over ICI instead of n ppermute hops — better when
+    H >= n and the interconnect favors bulk all_to_all.  Requires the
+    head count divisible by the sp axis size; GQA: repeat kv heads first.
+
+    q, k, v: (B, H, L_local, D) — the local sequence shard.
+    Returns (B, H, L_local, D).
+    """
+    from jax import lax
+
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)
+    h = q.shape[1]
+    if h % n:
+        raise ValueError(f"ulysses_attention needs heads ({h}) divisible "
+                         f"by the {axis_name!r} axis size ({n})")
+
+    def to_seq(x):     # (B, H, L/n, D) -> (B, H/n, L, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_heads(x):   # (B, H/n, L, D) -> (B, H, L/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qs, ks, vs = to_seq(q), to_seq(k), to_seq(v)
+    # the per-head-shard attention is the shared dense reference kernel
+    # (one implementation to fix, same numerics as the flash fallback)
+    from ..ops.flash_attention import _mha_reference
+
+    o = _mha_reference(qs, ks, vs, causal, sm_scale)
+    return to_heads(o)
+
+
+def ulysses_context_parallel_attention(q, k, v, mesh, axis_name="sp",
+                                       causal=False, sm_scale=None):
+    """Full-sequence attention with the sequence axis sharded over
+    ``axis_name`` via the Ulysses all_to_all schedule (the seq-sharded
+    analog of :func:`context_parallel_attention`)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale)
     sharded = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                             out_specs=spec, check_vma=False)
     return sharded(q, k, v)

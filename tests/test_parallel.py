@@ -445,3 +445,52 @@ def test_moe_bf16_queue_positions_do_not_collide():
     assert float(aux["dropped"]) == 0.0, aux["dropped"]
     assert float(aux["expert_load"][0]) == T
     assert np.isfinite(np.asarray(out, dtype="f")).all()
+
+
+def test_ulysses_attention_matches_reference_and_ring():
+    """DeepSpeed-Ulysses all_to_all sequence parallelism (the complement
+    of ring attention): output and grads exactly match full attention,
+    and agree with the ring schedule."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.ops.flash_attention import _mha_reference
+    from mxnet_tpu.parallel.context_parallel import (
+        context_parallel_attention, ulysses_context_parallel_attention)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("sp",))
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(2, 8, 64, 16).astype("f"))
+    k = jnp.asarray(rs.randn(2, 8, 64, 16).astype("f"))
+    v = jnp.asarray(rs.randn(2, 8, 64, 16).astype("f"))
+    for causal in (False, True):
+        o = ulysses_context_parallel_attention(q, k, v, mesh,
+                                               causal=causal)
+        ref = _mha_reference(q, k, v, causal, 1.0 / np.sqrt(16))
+        assert float(jnp.abs(o - ref).max()) < 1e-4
+        ring = context_parallel_attention(q, k, v, mesh, causal=causal)
+        assert float(jnp.abs(o - ring).max()) < 1e-4
+
+    g = jax.grad(lambda qq: (ulysses_context_parallel_attention(
+        qq, k, v, mesh, causal=True) ** 2).sum())(q)
+    gref = jax.grad(lambda qq: (_mha_reference(
+        qq, k, v, True, 1.0 / np.sqrt(16)) ** 2).sum())(q)
+    assert float(jnp.abs(g - gref).max()) < 1e-3
+
+
+def test_ulysses_attention_rejects_indivisible_heads():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.parallel.context_parallel import (
+        ulysses_context_parallel_attention)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("sp",))
+    q = jnp.zeros((1, 4, 16, 8), "f")  # 4 heads, 8-way sp
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_context_parallel_attention(q, q, q, mesh)
