@@ -295,16 +295,26 @@ def _probe_backend(timeout=90, retries=2):
 
     err = None
     for attempt in range(retries):
+        # Popen + SIGTERM-with-grace, NOT subprocess.run(timeout=...):
+        # run() SIGKILLs on timeout, and killing a mid-init TPU client is
+        # exactly what wedges the single-client axon tunnel
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
-                timeout=timeout, capture_output=True, text=True)
-            out = (r.stdout or "").strip()
-            if r.returncode == 0 and out:
+            stdout, stderr = proc.communicate(timeout=timeout)
+            out = (stdout or "").strip()
+            if proc.returncode == 0 and out:
                 return out.splitlines()[-1], None
-            err = ((r.stderr or "") + out)[-300:] or f"rc={r.returncode}"
+            err = ((stderr or "") + out)[-300:] or f"rc={proc.returncode}"
         except subprocess.TimeoutExpired:
+            proc.terminate()               # graceful client teardown first
+            try:
+                proc.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
             err = f"backend init timed out after {timeout}s (tunnel wedged?)"
         if attempt + 1 < retries:
             time.sleep(5)
