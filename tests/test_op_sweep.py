@@ -508,6 +508,27 @@ add("_contrib_dequantize",
     lambda rs: [rs.randint(-100, 100, (2, 3)).astype("int8"),
                 np.array([-1.0], "f"), np.array([1.0], "f")],
     grad=False, dtypes=())
+add("amp_multicast", P((2, 3), (4,)), kwargs={"num_outputs": 2},
+    grad=False)
+add("_sg_fused_dense_act", P((2, 3), (4, 3), (4,)),
+    kwargs={"num_hidden": 4, "act_type": "relu"})
+add("_sg_fused_conv_act", P((1, 2, 4, 4), (3, 2, 2, 2), (3,)),
+    kwargs={"kernel": (2, 2), "num_filter": 3, "act_type": "relu"},
+    rtol=3e-2, atol=3e-3)
+add("_contrib_quantized_fully_connected",
+    lambda rs: [rs.uniform(-1, 1, (2, 3)).astype("f"),
+                rs.randint(-127, 127, (4, 3)).astype("int8"),
+                np.array([0.02], "f"),
+                np.array([-1.0, 1.0], "f"),
+                rs.uniform(-0.1, 0.1, (4,)).astype("f")],
+    kwargs={"num_hidden": 4}, grad=False, dtypes=())
+add("_contrib_quantized_conv",
+    lambda rs: [rs.uniform(-1, 1, (1, 2, 4, 4)).astype("f"),
+                rs.randint(-127, 127, (3, 2, 2, 2)).astype("int8"),
+                np.array([0.02], "f"),
+                np.array([-1.0, 1.0], "f")],
+    kwargs={"kernel": (2, 2), "num_filter": 3, "no_bias": True},
+    grad=False, dtypes=())
 add("_contrib_requantize",
     lambda rs: [rs.randint(-1000, 1000, (2, 3)).astype("int32"),
                 np.array([-10.0], "f"), np.array([10.0], "f")],
