@@ -26,6 +26,11 @@ __all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
 
 _DEFAULT_TARGET = "bfloat16"
 
+# monotonic policy-install token (never rewinds): two different scoped
+# policies can never share a dispatch-cache key even after _cast_scope
+# restores earlier state
+_EPOCH = iter(range(1, 1 << 62)).__next__
+
 
 def _amp_dict():
     from ...ndarray.ndarray import _AMP
@@ -80,6 +85,9 @@ def init(target_dtype=_DEFAULT_TARGET, target_dtype_ops=None, fp32_ops=None,
     st = _amp_dict()
     st["wrap"] = _make_wrap(target_dtype, t_ops, f_ops)
     st["target"] = target_dtype
+    # fresh policy token: the eager dispatch cache keys executables on it,
+    # so re-init with different lists/dtype can never serve stale casts
+    st["epoch"] = _EPOCH()
     st["on"] = True
 
 
@@ -89,6 +97,7 @@ def disable():
     st["on"] = False
     st["wrap"] = None
     st["target"] = None
+    st["epoch"] = _EPOCH()
 
 
 @contextmanager

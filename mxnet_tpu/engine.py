@@ -89,6 +89,11 @@ def set_engine_type(name):
     # switch must apply process-wide (data-loader/prefetch threads included),
     # so flip the global config value instead.
     jax.config.update("jax_disable_jit", name == "NaiveEngine")
+    # the eager jit-cache must not serve fused executables in op-by-op
+    # deterministic mode
+    from .ndarray import dispatch_cache as _dc
+
+    _dc.set_engine_bypass(name == "NaiveEngine")
     _engine_type = name
 
 

@@ -26,6 +26,17 @@ Wired vars (read at ``import mxnet_tpu``):
 - ``MXNET_COORDINATOR_ADDRESS``: jax.distributed coordinator override
   (read in parallel.distributed.init).
 - ``MXNET_TEST_TPU``: selects the real-chip test lane (tests/conftest.py).
+- ``MXNET_EAGER_JIT``: eager jit-cache fast path on the imperative dispatch
+  seam (default 1; see ndarray/dispatch_cache.py, ≙ the reference's
+  CachedOp amortization of per-op launch cost).
+- ``MXNET_EAGER_JIT_CACHE_SIZE``: executable LRU capacity (default 1024).
+- ``MXNET_MP_START_METHOD``: DataLoader process-worker start method
+  (default ``spawn``; ``fork`` is an explicit opt-in — the parent is
+  always multi-threaded and fork can deadlock children on inherited
+  locks).
+- ``MXNET_BENCH_FORCE_SWEEP``: run the TPU-gated bench sweep branches
+  (resnet config sweep, flash-block grid) on CPU too, so the sweep and
+  headline-selection code paths are exercised before first chip contact.
 
 Accepted-but-subsumed (XLA owns the concern; reads return the default and
 ``describe()`` says why):
@@ -111,6 +122,13 @@ def describe():
         ("MXNET_FLASH_BLOCK_KV", "flash-attention kv tile (default 128)"),
         ("MXNET_COORDINATOR_ADDRESS", "jax.distributed coordinator"),
         ("MXNET_TEST_TPU", "real-chip test lane"),
+        ("MXNET_EAGER_JIT", "eager jit-cache fast path (default 1; "
+         "ndarray/dispatch_cache.py)"),
+        ("MXNET_EAGER_JIT_CACHE_SIZE", "dispatch-cache LRU capacity "
+         "(default 1024)"),
+        ("MXNET_MP_START_METHOD", "DataLoader process-worker start method "
+         "(default spawn)"),
+        ("MXNET_BENCH_FORCE_SWEEP", "run TPU-gated bench sweeps on CPU"),
     ]
     for name, what in wired:
         lines.append(f"{name}={os.environ.get(name, '<unset>')} — {what}")

@@ -70,21 +70,9 @@ def _worker_initializer(dataset):
         pass
 
 
-def _parent_device_runtime_active():
-    """True if this process has already initialized a non-CPU jax backend —
-    fork()ing then dispatching in the child would reuse the inherited TPU
-    client (the axon tunnel is single-client), so the loader switches to
-    spawn in that case."""
-    import sys
-
-    if "jax" not in sys.modules:
-        return False
-    try:
-        from jax._src import xla_bridge
-
-        return any(p != "cpu" for p in xla_bridge._backends)
-    except Exception:
-        return True  # unknown runtime state: be conservative
+def _terminate_pool(pool):
+    pool.terminate()
+    pool.join()
 
 
 class _WorkerFn:
@@ -127,6 +115,9 @@ class DataLoader:
         self._num_workers = num_workers
         self._thread_pool = thread_pool
         self._prefetch = max(0, prefetch or 2 * max(num_workers, 1))
+        self._proc_pool = None          # persistent process pool (spawn is
+        self._proc_pool_method = None   # expensive: pay startup once)
+        self._pool_finalizer = None
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -146,38 +137,33 @@ class DataLoader:
         _MultiWorkerIter).  Workers produce numpy batches (pickle
         transport); the parent converts to NDArray.
 
-        Children must not touch the parent's device runtime: the worker
-        initializer pins jax to CPU before any dispatch, and if the parent
-        has ALREADY initialized a non-CPU backend the pool switches from
-        fork to spawn (a forked child would inherit the live TPU client —
-        the axon tunnel is single-client).  Override the start method with
-        MXNET_MP_START_METHOD=fork|spawn."""
-        import multiprocessing as mp
-        import os
+        Start method defaults to ``spawn``: the parent is effectively
+        always multi-threaded (prefetch ThreadPoolExecutor, jax runtime
+        internals), and fork() from a multi-threaded process can deadlock
+        children on inherited locks (Python 3.12 DeprecationWarning) — and
+        a forked child would also inherit a live TPU client (the axon
+        tunnel is single-client).  ``fork`` remains an explicit opt-in via
+        MXNET_MP_START_METHOD=fork (``forkserver`` also accepted).  Spawn
+        imposes the standard multiprocessing contract fork did not: the
+        dataset/batchify must be picklable (no lambdas) and scripts that
+        iterate a DataLoader at module top level need an
+        ``if __name__ == "__main__":`` guard.  Either way the worker
+        initializer pins jax in the child to CPU before any dispatch.
 
+        The pool PERSISTS across epochs (a spawn startup per __iter__
+        would cost num_workers interpreter launches + imports every
+        epoch): workers snapshot the dataset once at pool creation, so
+        in-place dataset mutations between epochs are not visible to
+        process workers — build a new DataLoader for a new dataset."""
         fn = self._batchify_fn
         if fn is default_batchify_fn:
             fn = default_mp_batchify_fn
-        method = os.environ.get("MXNET_MP_START_METHOD")
-        if method is None:
-            method = "spawn" if _parent_device_runtime_active() else "fork"
-        ctx = mp.get_context(method)
-        prev = os.environ.get("JAX_PLATFORMS")
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        try:
-            pool = ctx.Pool(self._num_workers,
-                            initializer=_worker_initializer,
-                            initargs=(self._dataset,))
-        finally:
-            if prev is None:
-                os.environ.pop("JAX_PLATFORMS", None)
-            else:
-                os.environ["JAX_PLATFORMS"] = prev
+        pool = self._get_proc_pool()
         # bound in-flight work: imap's feeder thread would otherwise
         # enqueue the whole epoch and buffer every finished batch.  The
         # stop event unblocks the feeder if the consumer abandons the
-        # iterator early — pool.join() must not wait on a feeder thread
-        # parked in sem.acquire().
+        # iterator early (queued tasks drain harmlessly in the background
+        # of the persistent pool).
         sem = threading.BoundedSemaphore(self._num_workers + self._prefetch)
         stop = threading.Event()
 
@@ -196,8 +182,57 @@ class DataLoader:
                 yield _to_nd(out)
         finally:
             stop.set()
-            pool.terminate()
-            pool.join()
+
+    def _get_proc_pool(self):
+        import multiprocessing as mp
+        import os
+        import weakref
+
+        method = os.environ.get("MXNET_MP_START_METHOD") or "spawn"
+        if self._proc_pool is not None and self._proc_pool_method == method:
+            return self._proc_pool
+        self._shutdown_proc_pool()
+        ctx = mp.get_context(method)
+        prev = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            pool = ctx.Pool(self._num_workers,
+                            initializer=_worker_initializer,
+                            initargs=(self._dataset,))
+        finally:
+            if prev is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = prev
+        self._proc_pool = pool
+        self._proc_pool_method = method
+        # terminate workers when the loader is garbage collected (or at
+        # interpreter exit) — __del__ alone is not reliable enough for
+        # child processes
+        self._pool_finalizer = weakref.finalize(
+            self, _terminate_pool, pool)
+        return pool
+
+    def _shutdown_proc_pool(self):
+        if self._pool_finalizer is not None:
+            self._pool_finalizer()  # terminates + joins, idempotent
+            self._pool_finalizer = None
+        self._proc_pool = None
+        self._proc_pool_method = None
+
+    def close(self):
+        """Release the persistent worker processes now instead of at GC /
+        interpreter exit.  The loader remains usable — the next process-
+        worker epoch starts a fresh pool.  Also usable as a context
+        manager: ``with DataLoader(...) as dl: ...``."""
+        self._shutdown_proc_pool()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def _threaded_iter(self):
         pool = ThreadPoolExecutor(max_workers=self._num_workers)

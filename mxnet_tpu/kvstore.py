@@ -73,6 +73,13 @@ class KVStore:
         self._optimizer = None
         self._compression = None
         self._pending_host_state = {}
+        # per-key traffic history, persisted across promote/demote cycles
+        # (ADVICE r5 #1: promote/demote thrash).  _sparse_push_counts
+        # survives a demote so a re-promoted key re-enters the
+        # mixed-workload path; _dense_pushed gates row_sparse_pull
+        # promotion for keys whose traffic has been dense.
+        self._sparse_push_counts = {}
+        self._dense_pushed = set()
 
     @property
     def type(self):
@@ -106,6 +113,8 @@ class KVStore:
         keys, grouped = _group_key_value(key, value)
         for k, vals in zip(keys, grouped):
             reduced = _reduce(vals)
+            if not isinstance(reduced, RowSparseNDArray):
+                self._dense_pushed.add(k)
             if (isinstance(reduced, RowSparseNDArray)
                     and self._updater is not None
                     and self._optimizer is not None
@@ -181,7 +190,14 @@ class KVStore:
                 raise MXNetError(f"key {k} not initialized in kvstore")
             src = self._store[k]
             if not isinstance(src, _HostRowSparseTable) and \
-                    not getattr(self, "_sharded_update", False):
+                    not getattr(self, "_sharded_update", False) and \
+                    (self._sparse_push_counts.get(k, 0) > 0
+                     or k not in self._dense_pushed):
+                # promote only keys whose traffic is actually row-sparse:
+                # a key that has seen dense pushes and no sparse push stays
+                # on the device-side take path — otherwise an alternating
+                # dense-push/row_sparse_pull workload pays a full-table
+                # D2H+H2D promote/demote round trip per step (ADVICE r5 #1)
                 val = src._get()
                 sh = getattr(val, "sharding", None)
                 if sh is None or len(sh.device_set) <= 1:
@@ -229,6 +245,10 @@ class KVStore:
         if sharding is not None and len(sharding.device_set) > 1:
             return None
         host = _HostRowSparseTable(_np.asarray(val))  # one-time D2H
+        # re-promoted keys keep their sparse-push history: the
+        # mixed-workload dense path (push) can engage immediately instead
+        # of demoting on the first dense gradient
+        host.sparse_pushes = self._sparse_push_counts.get(k, 0)
         if k in self._pending_host_state:
             # state saved by save_optimizer_states before this key was
             # re-promoted in the restored process
@@ -330,6 +350,7 @@ class KVStore:
             rows, vals = uniq, merged
         idx = _key_int(k)
         host.sparse_pushes += 1
+        self._sparse_push_counts[k] = self._sparse_push_counts.get(k, 0) + 1
         w_rows = host.table[rows]
         w_nd = NDArray._from_jax(jnp.asarray(w_rows))
         g_nd = NDArray._from_jax(jnp.asarray(vals))
@@ -382,6 +403,23 @@ class KVStore:
             raise MXNetError(f"unknown gradient compression type {ctype}")
 
     # -- optimizer state io --------------------------------------------------
+    #
+    # File format (two variants, discriminated by an explicit header —
+    # never by speculative unpickling):
+    #
+    # 1. plain: the updater's own states blob, byte-for-byte (what the
+    #    reference's mx.mod/Trainer save_optimizer_states writes) — used
+    #    whenever no host-resident row-sparse keys hold server-side state.
+    # 2. bundled: the 8-byte magic ``MXKVOPT1`` followed by a pickled
+    #    ``{"updater": <plain blob>, "host_states": {key: state}}`` dict —
+    #    host-resident row-sparse keys keep their optimizer state
+    #    server-side and it must survive the round trip.
+    #
+    # The magic cannot collide with variant 1: updater blobs are pickle
+    # streams and no pickle protocol starts with b"MXKV".  Readers that
+    # predate the bundled format still load variant-1 files unchanged.
+    _STATE_MAGIC = b"MXKVOPT1"
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("no updater attached")
@@ -390,10 +428,9 @@ class KVStore:
                 if isinstance(v, _HostRowSparseTable) and v.state is not None}
         with open(fname, "wb") as f:
             if host:
-                # host-resident row-sparse keys keep their optimizer state
-                # server-side; bundle it alongside the updater blob
-                f.write(pickle.dumps({"__kv_host_states__": host,
-                                      "updater": blob}))
+                f.write(self._STATE_MAGIC)
+                f.write(pickle.dumps({"updater": blob,
+                                      "host_states": host}))
             else:
                 f.write(blob)
 
@@ -402,19 +439,30 @@ class KVStore:
             raise MXNetError("no updater attached")
         with open(fname, "rb") as f:
             data = f.read()
+        if data.startswith(self._STATE_MAGIC):
+            obj = pickle.loads(data[len(self._STATE_MAGIC):])
+            self._adopt_bundled_states(obj["updater"], obj["host_states"])
+            return
+        # pre-MXKVOPT1 files only: one generation of bundled state shipped
+        # as a bare pickled wrapper dict.  This is the sole remaining
+        # sniff, scoped to that marker key; drop it when those files age out.
         try:
-            obj = pickle.loads(data)
-        except Exception:  # pragma: no cover - non-pickle payloads
-            obj = None
-        if isinstance(obj, dict) and "__kv_host_states__" in obj:
-            self._updater.set_states(obj["updater"])
-            self._pending_host_state.update(obj["__kv_host_states__"])
-            for k in list(self._pending_host_state):
-                cur = self._store.get(k)
-                if isinstance(cur, _HostRowSparseTable):
-                    cur.state = self._pending_host_state.pop(k)
+            legacy = pickle.loads(data)
+        except Exception:
+            legacy = None
+        if isinstance(legacy, dict) and "__kv_host_states__" in legacy:
+            self._adopt_bundled_states(legacy["updater"],
+                                       legacy["__kv_host_states__"])
         else:
             self._updater.set_states(data)
+
+    def _adopt_bundled_states(self, updater_blob, host_states):
+        self._updater.set_states(updater_blob)
+        self._pending_host_state.update(host_states)
+        for k in list(self._pending_host_state):
+            cur = self._store.get(k)
+            if isinstance(cur, _HostRowSparseTable):
+                cur.state = self._pending_host_state.pop(k)
 
     def barrier(self):
         _ndm.waitall()

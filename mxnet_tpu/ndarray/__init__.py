@@ -16,9 +16,32 @@ from .. import ops as _ops  # noqa: F401  (populates the table)
 from ..ops.registry import OP_TABLE, list_ops
 from ..context import current_context
 from .ndarray import NDArray, array, invoke, waitall, concatenate
+from . import dispatch_cache as _dispatch_cache
 
 __all__ = ["NDArray", "array", "invoke", "waitall", "zeros", "ones", "full",
-           "arange", "empty", "concat", "concatenate", "list_ops", "save", "load"]
+           "arange", "empty", "concat", "concatenate", "list_ops", "save", "load",
+           "dispatch_stats", "reset_dispatch_stats", "set_eager_jit"]
+
+
+def dispatch_stats(reset=False):
+    """Eager jit-cache counters: hits/misses/evictions/bypasses, cache
+    size/capacity, and per-op hit/miss breakdown (see
+    ndarray/dispatch_cache.py; knobs: MXNET_EAGER_JIT,
+    MXNET_EAGER_JIT_CACHE_SIZE)."""
+    out = _dispatch_cache.stats()
+    if reset:
+        _dispatch_cache.reset_stats()
+    return out
+
+
+def reset_dispatch_stats():
+    _dispatch_cache.reset_stats()
+
+
+def set_eager_jit(flag):
+    """Runtime switch for the eager jit-cache fast path (env:
+    MXNET_EAGER_JIT).  Returns the previous setting."""
+    return _dispatch_cache.set_enabled(flag)
 
 
 def _make_op_func(opname, od):

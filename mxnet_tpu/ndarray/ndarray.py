@@ -29,6 +29,7 @@ from ..base import MXNetError, numeric_types, integer_types
 from ..context import Context, current_context
 from .. import autograd as _ag
 from ..ops.registry import get_op
+from . import dispatch_cache as _dc
 
 __all__ = ["NDArray", "invoke", "array", "waitall", "concatenate"]
 
@@ -633,7 +634,9 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
     """Execute a registered op on NDArray inputs.
 
     1. unwrap inputs (snapshot jax values — free, they're immutable)
-    2. run the pure fn (jax dispatches async ≙ Engine::PushAsync)
+    2. run the pure fn (jax dispatches async ≙ Engine::PushAsync) — repeat
+       calls go through a jit-cached executable (dispatch_cache.py, the
+       CachedOp-style fast path) instead of per-primitive eager dispatch
     3. record on the autograd tape if needed (≙ Imperative::RecordOp)
     4. wrap outputs in NDArrays
     """
@@ -643,7 +646,6 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
 
         if any(isinstance(a, SymbolTracer) for a in nd_args if a is not None):
             return trace_invoke(opname, nd_args, attrs)
-    attrs = {k: v for k, v in attrs.items() if v is not None or k in ("axis", "a_min", "a_max")}
     nd_args = [a for a in nd_args if a is not None]  # optional inputs omitted
     in_vals = []
     out_ctx = ctx
@@ -661,12 +663,38 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
     if od.creation and out_ctx is None:
         out_ctx = current_context()
 
-    fn = functools.partial(_call_with_attrs, od.fn, attrs)
-    if _AMP["on"]:
-        # mixed-precision cast policy (contrib.amp): wraps fn so per-op input
-        # casts are part of the traced/vjp'd computation — gradients flow back
-        # to the original (fp32 master) dtype through the cast's transpose
-        fn = _AMP["wrap"](od, fn)
+    # jit-cache fast path (dispatch_cache.py): serve a compiled executable
+    # keyed on (op, static attrs, input avals, AMP state, ctx kind, train
+    # mode).  Keyed on the RAW attrs — filtering is deterministic per raw
+    # attrs, so a hit skips it entirely.  Any incompatible mode (unhashable
+    # attrs, tracer inputs, trace-scoped RNG, NaiveEngine, blocklisted op)
+    # falls through to the plain eager path below.
+    fn = None
+    call_fn = None
+    cache_key = None
+    if (_dc.enabled() and od.jit_safe and not _dc.is_blocked(od.name)
+            and not _rng_in_trace(od)):
+        cache_key = _dc.make_key(
+            od.name, attrs, in_vals,
+            (_AMP["epoch"] if _AMP["on"] else None),
+            (out_ctx.device_type if out_ctx is not None else None),
+            _ag.is_training(), stats_name=opname)
+        if cache_key is not None:
+            # stats keyed on the CALL-SITE name (so aliased ops line up
+            # with the profiler's per-op rows); the cache key and the
+            # blocklist use the canonical od.name so aliases share entries
+            call_fn = _dc.lookup(opname, cache_key)
+    if call_fn is None:
+        attrs = {k: v for k, v in attrs.items()
+                 if v is not None or k in ("axis", "a_min", "a_max")}
+        fn = functools.partial(_call_with_attrs, od.fn, attrs)
+        if _AMP["on"]:
+            # mixed-precision cast policy (contrib.amp): wraps fn so per-op
+            # input casts are part of the traced/vjp'd computation —
+            # gradients flow back to the original (fp32 master) dtype
+            # through the cast's transpose
+            fn = _AMP["wrap"](od, fn)
+        call_fn = _jax().jit(fn) if cache_key is not None else fn
 
     recording = (_ag.is_recording() and od.differentiable
                  and any(isinstance(a, NDArray) and _on_tape(a) for a in nd_args if a is not None))
@@ -681,13 +709,40 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
 
         _prof_t0 = _time.perf_counter()
 
-    if recording:
-        entries = [(a._ag_entry if isinstance(a, NDArray) else None) for a in nd_args]
-        out_vals, out_entries, multi = _ag.record_op(fn, in_vals, entries, name=opname)
-    else:
-        out_vals = fn(*in_vals)
-        multi = isinstance(out_vals, (tuple, list))
-        out_entries = None
+    try:
+        if recording:
+            entries = [(a._ag_entry if isinstance(a, NDArray) else None)
+                       for a in nd_args]
+            # jit under record_op's vjp: the forward executes compiled and
+            # the vjp's transpose compiles too (pjit jvp/transpose rules)
+            out_vals, out_entries, multi = _ag.record_op(
+                call_fn, in_vals, entries, name=opname)
+        else:
+            out_vals = call_fn(*in_vals)
+            multi = isinstance(out_vals, (tuple, list))
+            out_entries = None
+    except Exception:
+        if fn is None or call_fn is fn:
+            raise  # plain eager path (or cached-hit): the error is real
+        # first compile of this key failed: retry eagerly.  A real data
+        # error raises identically from the eager run and propagates; if
+        # eager *succeeds* this (op, attrs, avals) variant is
+        # trace-incompatible — cache the EAGER fn in its slot (no retrace
+        # on repeats, other variants keep the fast path) and record the
+        # failure, escalating to an op-wide block only if more keys fail.
+        call_fn = fn
+        if recording:
+            entries = [(a._ag_entry if isinstance(a, NDArray) else None)
+                       for a in nd_args]
+            out_vals, out_entries, multi = _ag.record_op(
+                fn, in_vals, entries, name=opname)
+        else:
+            out_vals = fn(*in_vals)
+            multi = isinstance(out_vals, (tuple, list))
+            out_entries = None
+        _dc.mark_unsafe(od.name)
+    if fn is not None and cache_key is not None:
+        _dc.insert(cache_key, call_fn)
 
     if _prof_rec is not None:
         _sync = out_vals[0] if multi else out_vals
@@ -721,8 +776,20 @@ _SYMTRACE = {"on": False}
 
 # mixed-precision state, owned by contrib.amp (reference: amp.init()
 # monkey-patches op namespaces — here one dict lookup gates the hot path).
-# "wrap": callable(opdef, fn) -> fn installed by contrib.amp.
-_AMP = {"on": False, "wrap": None}
+# "wrap": callable(opdef, fn) -> fn installed by contrib.amp.  "epoch" is a
+# monotonic token bumped on every policy (re)install: the dispatch cache
+# keys executables on it so a policy change can never serve stale casts.
+_AMP = {"on": False, "wrap": None, "epoch": 0}
+
+
+def _rng_in_trace(od):
+    """True when this needs_rng op draws from a trace-scoped key (inside a
+    hybridize/TrainStep trace): the outer jit owns compilation then."""
+    if not od.needs_rng:
+        return False
+    from .. import random as _rnd
+
+    return _rnd._in_trace()
 
 # per-op profiling state, owned by profiler.py ("record": callable(opname,
 # t0, t1) installed while profiling imperative ops is enabled)

@@ -295,7 +295,11 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
 from .registry import register
 
 
-@register("_contrib_flash_attention", aliases=("flash_attention",))
+# jit_safe=False: the op re-reads MXNET_FLASH_BLOCK_{Q,KV} per call (the
+# bench block sweep depends on that), so it must not be frozen into a cached
+# eager executable; per-call overhead is irrelevant at attention sizes
+@register("_contrib_flash_attention", aliases=("flash_attention",),
+          jit_safe=False)
 def flash_attention_op(q, k, v, causal=False, sm_scale=None):
     """Fused scaled-dot-product attention (net-new vs reference; the TPU
     answer to contrib/transformer.cc's unfused attention path)."""

@@ -33,13 +33,20 @@ class OpDef:
         Frontend threads a jax PRNG key as the first positional array.
     differentiable : bool
         False -> never recorded on the autograd tape (int outputs etc.).
+    jit_safe : bool
+        False -> the eager jit-cache fast path (ndarray/dispatch_cache.py)
+        never compiles this op: its Python body is intentionally re-run per
+        call (reads env/global state at call time, value-dependent host
+        logic).  Trace *failures* are additionally caught at runtime and
+        blocklisted, so this flag is for ops that trace fine but must not
+        be frozen into an executable.
     """
 
     __slots__ = ("name", "fn", "nout", "creation", "needs_rng", "differentiable",
-                 "aliases")
+                 "aliases", "jit_safe")
 
     def __init__(self, name, fn, nout=1, creation=False, needs_rng=False,
-                 differentiable=True, aliases=()):
+                 differentiable=True, aliases=(), jit_safe=True):
         self.name = name
         self.fn = fn
         self.nout = nout
@@ -47,16 +54,18 @@ class OpDef:
         self.needs_rng = needs_rng
         self.differentiable = differentiable
         self.aliases = aliases
+        self.jit_safe = jit_safe
 
 
 def register(name=None, nout=1, creation=False, needs_rng=False,
-             differentiable=True, aliases=()):
+             differentiable=True, aliases=(), jit_safe=True):
     """Decorator: register a pure function as an operator."""
 
     def _do(fn):
         opname = name or fn.__name__
         od = OpDef(opname, fn, nout=nout, creation=creation, needs_rng=needs_rng,
-                   differentiable=differentiable, aliases=aliases)
+                   differentiable=differentiable, aliases=aliases,
+                   jit_safe=jit_safe)
         if opname in OP_TABLE:
             raise MXNetError(f"duplicate op registration: {opname}")
         OP_TABLE[opname] = od

@@ -77,7 +77,9 @@ _unary("ceil", lambda jnp, x: jnp.ceil(x), differentiable=False)
 _unary("round", lambda jnp, x: jnp.round(x), differentiable=False)
 _unary("rint", lambda jnp, x: jnp.rint(x), differentiable=False)
 _unary("trunc", lambda jnp, x: jnp.trunc(x), differentiable=False)
-_unary("fix", lambda jnp, x: jnp.fix(x), differentiable=False)
+# fix == truncate toward zero; jnp.trunc is the stable spelling (jnp.fix
+# rides numpy's deprecation track)
+_unary("fix", lambda jnp, x: jnp.trunc(x), differentiable=False)
 _unary("gamma", lambda jnp, x: _gamma_impl(jnp, x))
 _unary("gammaln", lambda jnp, x: _gammaln_impl(jnp, x))
 _unary("erf", lambda jnp, x: _erf_impl(jnp, x))

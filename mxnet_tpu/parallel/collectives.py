@@ -13,7 +13,33 @@ from functools import partial
 __all__ = ["psum", "pmean", "all_gather", "reduce_scatter", "ppermute",
            "all_to_all", "allreduce_hosts", "allreduce_hosts_quantized",
            "allreduce_hosts_quantized_multi",
-           "barrier"]
+           "barrier", "shard_map"]
+
+
+def shard_map(fn, mesh, in_specs, out_specs):
+    """Version-compat ``shard_map`` with replication checking off.
+
+    jax >= 0.6 exposes ``jax.shard_map`` (``check_vma=``) and deprecates
+    ``jax.experimental.shard_map`` (``check_rep=``); older jax only has the
+    experimental one.  Every shard_map in this repo wants the check off
+    (collectives make replication explicit), so one helper owns the
+    divergence instead of each call site pinning an API generation.
+    """
+    import inspect
+
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        impl = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as impl
+    # pick the check kwarg by signature, not API location: the 0.6-era
+    # promotion window had jax.shard_map still spelling it check_rep
+    params = inspect.signature(impl).parameters
+    check = {"check_vma": False} if "check_vma" in params else \
+        {"check_rep": False}
+    return impl(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **check)
 
 
 def psum(x, axis_name="dp"):

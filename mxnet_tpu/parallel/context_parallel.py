@@ -78,8 +78,10 @@ def context_parallel_attention(q, k, v, mesh, axis_name="sp", causal=False,
     spec = P(None, None, axis_name, None)
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal,
                            sm_scale=sm_scale)
-    sharded = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                            out_specs=spec, check_vma=False)
+    from .collectives import shard_map
+
+    sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec)
     return sharded(q, k, v)
 
 
@@ -134,6 +136,8 @@ def ulysses_context_parallel_attention(q, k, v, mesh, axis_name="sp",
     spec = P(None, None, axis_name, None)
     fn = functools.partial(ulysses_attention, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale)
-    sharded = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                            out_specs=spec, check_vma=False)
+    from .collectives import shard_map
+
+    sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec)
     return sharded(q, k, v)

@@ -58,7 +58,8 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+
+    from .collectives import shard_map
 
     if schedule not in ("gpipe", "1f1b"):
         raise MXNetError(f"unknown pipeline schedule {schedule!r}")
@@ -214,5 +215,5 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
     xs_spec = P(None, bspec, *([None] * (xs.ndim - 2)))
     in_specs = (pspecs, xs_spec)
     y = shard_map(pp_fn, mesh=mesh, in_specs=in_specs,
-                  out_specs=xs_spec, check_rep=False)(stage_params, xs)
+                  out_specs=xs_spec)(stage_params, xs)
     return y.reshape(x.shape)

@@ -136,18 +136,36 @@ def resume():
 def dump(finished=True, profile_process="worker"):
     """Write the Chrome traceEvents file (open in chrome://tracing /
     Perfetto; the XLA-level trace lives in jax_trace/ for TensorBoard)."""
+    from .ndarray import dispatch_cache as _dc
+
+    dstats = _dc.stats()
     with _LOCK:
         events = list(_EVENTS)
     with open(_CONFIG["filename"], "w") as f:
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms",
                    "otherData": {
-                       "xla_trace": "see jax_trace/ (TensorBoard)"}}, f)
+                       "xla_trace": "see jax_trace/ (TensorBoard)",
+                       "eager_dispatch_cache": {
+                           k: dstats[k] for k in
+                           ("enabled", "hits", "misses", "evictions",
+                            "bypasses", "size", "capacity")}}}, f)
     return _CONFIG["filename"]
 
 
 def dumps(reset=False):
-    """Aggregate per-op statistics table (reference: profiler.dumps)."""
+    """Aggregate per-op statistics table (reference: profiler.dumps), with
+    the eager dispatch-cache hit/miss per op (ndarray/dispatch_cache.py)
+    appended so the jit fast path's behavior shows up next to the timings.
+
+    The Jit columns are the dispatch cache's own cumulative counters (all
+    invokes since mx.nd.reset_dispatch_stats(), profiling on or off) — they
+    are NOT bounded by the Count column, which only accumulates while
+    profiling is active, and ``reset=True`` does not clear them."""
+    from .ndarray import dispatch_cache as _dc
+
+    dstats = _dc.stats()
+    per_op = dstats["per_op"]
     with _LOCK:
         rows = [(name, a[0], a[1] * 1e3, a[2] * 1e3, a[3] * 1e3,
                  a[1] / a[0] * 1e3) for name, a in sorted(_AGG.items())]
@@ -156,10 +174,19 @@ def dumps(reset=False):
             _EVENTS.clear()
     lines = ["Profile Statistics:",
              f"{'Name':<32}{'Total Count':>12}{'Total(ms)':>12}"
-             f"{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}"]
+             f"{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}"
+             f"{'JitHit':>8}{'JitMiss':>8}"]
     for name, cnt, tot, mn, mx, avg in rows:
+        hm = per_op.get(name)
+        hit, miss = (hm["hits"], hm["misses"]) if hm else (0, 0)
         lines.append(f"{name:<32}{cnt:>12}{tot:>12.3f}{mn:>10.3f}"
-                     f"{mx:>10.3f}{avg:>10.3f}")
+                     f"{mx:>10.3f}{avg:>10.3f}{hit:>8}{miss:>8}")
+    lines.append(
+        f"Eager dispatch cache: enabled={dstats['enabled']} "
+        f"hits={dstats['hits']} misses={dstats['misses']} "
+        f"evictions={dstats['evictions']} bypasses={dstats['bypasses']} "
+        f"size={dstats['size']}/{dstats['capacity']} "
+        "(cumulative since reset_dispatch_stats; not scoped to profiling)")
     return "\n".join(lines)
 
 

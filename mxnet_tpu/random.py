@@ -48,6 +48,13 @@ def _next_key():
     return sub
 
 
+def _in_trace():
+    """True while a hybridize/jit trace owns the RNG (keys fold from a
+    traced base key).  The eager dispatch cache bypasses needs_rng ops in
+    this window — the outer jit owns compilation."""
+    return bool(_S.trace_stack)
+
+
 def _push_trace_key(base_key):
     box = [0]
     _S.trace_stack.append((base_key, box))

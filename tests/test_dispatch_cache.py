@@ -1,0 +1,334 @@
+"""Eager dispatch fast path (ISSUE 1 tentpole): jit-cached op executables.
+
+Covers the acceptance surface: hit/miss accounting, autograd parity
+(jit-on == jit-off gradients), AMP + profiler interplay, cache eviction,
+MXNET_EAGER_JIT=0 bypass parity — plus the never-break contract (trace
+fallback/blocklist, unhashable attrs, out= aliasing, RNG freshness,
+NaiveEngine bypass, NaN check).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.ndarray import dispatch_cache as dc
+
+
+@pytest.fixture(autouse=True)
+def _jit_on_clean():
+    """Every test starts jit-on with a cold cache and fresh counters."""
+    prev = nd.set_eager_jit(True)
+    dc.clear()
+    dc.reset_stats()
+    yield
+    nd.set_eager_jit(prev)
+
+
+def test_hit_miss_accounting_hot_loop():
+    """Acceptance: hits >> misses on a 100-iteration eager loop."""
+    x = nd.array(np.random.RandomState(0).randn(4, 8).astype("f"))
+    for _ in range(100):
+        y = x.softmax()
+    s = nd.dispatch_stats()
+    assert s["enabled"]
+    assert s["per_op"]["softmax"]["misses"] == 1
+    assert s["per_op"]["softmax"]["hits"] == 99
+    assert s["hits"] > 10 * max(s["misses"], 1)
+
+
+def test_forward_parity_on_off():
+    x = nd.array(np.random.RandomState(1).randn(8, 16).astype("f"))
+    ops = [lambda a: a.softmax(), lambda a: a.log_softmax(),
+           lambda a: a.mean(axis=1, keepdims=True), lambda a: a * a + a,
+           lambda a: mx.nd.Activation(a, act_type="softsign")]
+    for f in ops:
+        on = f(x).asnumpy()
+        nd.set_eager_jit(False)
+        off = f(x).asnumpy()
+        nd.set_eager_jit(True)
+        np.testing.assert_array_equal(on, off)
+
+
+def test_autograd_trajectory_parity():
+    """Acceptance: gradient trajectories identical jit-on vs jit-off over a
+    multi-step training-style loop."""
+
+    def run(jit_on):
+        nd.set_eager_jit(jit_on)
+        w = nd.array(np.linspace(-1, 1, 12).reshape(3, 4).astype("f"))
+        w.attach_grad()
+        traj = []
+        for step in range(5):
+            with ag.record():
+                h = (w * (step + 1)).softmax(axis=1)
+                loss = (h * w).sum()
+            loss.backward()
+            traj.append(w.grad.asnumpy().copy())
+            w -= 0.1 * w.grad
+        return traj, w.asnumpy()
+
+    traj_on, w_on = run(True)
+    traj_off, w_off = run(False)
+    for g_on, g_off in zip(traj_on, traj_off):
+        np.testing.assert_allclose(g_on, g_off, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(w_on, w_off, rtol=1e-6, atol=1e-7)
+
+
+def test_amp_interplay():
+    """AMP state is part of the cache key: the same (op, avals) under a
+    different cast policy must compile separately and cast correctly."""
+    from mxnet_tpu.contrib import amp
+
+    x = nd.array(np.random.RandomState(2).randn(4, 4).astype("f"))
+    y = nd.array(np.random.RandomState(3).randn(4, 4).astype("f"))
+    plain = mx.nd.dot(x, y)
+    assert plain.dtype == np.float32
+    try:
+        amp.init()  # bfloat16 target
+        mixed = mx.nd.dot(x, y)
+        assert str(mixed.dtype) == "bfloat16"
+        # warm both policies, then re-run: each policy hits its own entry
+        dc.reset_stats()
+        a = mx.nd.dot(x, y)
+        amp.disable()
+        b = mx.nd.dot(x, y)
+        s = nd.dispatch_stats()
+        assert str(a.dtype) == "bfloat16"
+        assert b.dtype == np.float32
+        assert s["per_op"]["dot"]["hits"] == 2
+    finally:
+        amp.disable()
+
+
+def test_profiler_interplay():
+    from mxnet_tpu import profiler
+
+    profiler.set_config(profile_imperative=True, filename="/tmp/_dcprof.json",
+                        jax_trace=False)
+    profiler.start()
+    x = nd.array(np.ones((4, 4), "f"))
+    for _ in range(10):
+        x.softmax()
+    profiler.stop()
+    table = profiler.dumps(reset=True)
+    profiler.set_config(profile_imperative=False, jax_trace=True)
+    assert "JitHit" in table and "JitMiss" in table
+    assert "Eager dispatch cache:" in table
+    row = [ln for ln in table.splitlines() if ln.startswith("softmax")]
+    assert row, table
+    # last two columns of the softmax row are its hit/miss counters
+    hits, misses = int(row[0].split()[-2]), int(row[0].split()[-1])
+    assert hits >= 9 and misses >= 1
+
+
+def test_eviction_bounded_lru():
+    prev = dc.capacity()
+    try:
+        dc.set_capacity(4)
+        for n in range(2, 12):  # 10 distinct avals -> evictions
+            nd.ones((n,)).softmax()
+        s = nd.dispatch_stats()
+        assert s["size"] <= 4
+        assert s["evictions"] >= 6
+    finally:
+        dc.set_capacity(prev)
+
+
+def test_eager_jit_off_bypass_parity():
+    x = nd.array(np.random.RandomState(4).randn(3, 5).astype("f"))
+    on = (x.softmax() + x).asnumpy()
+    nd.set_eager_jit(False)
+    dc.reset_stats()
+    off = (x.softmax() + x).asnumpy()
+    s = nd.dispatch_stats()
+    nd.set_eager_jit(True)
+    np.testing.assert_array_equal(on, off)
+    assert not s["enabled"]
+    assert s["hits"] == 0 and s["misses"] == 0  # fully out of the way
+
+
+def test_out_aliasing():
+    x = nd.array(np.arange(6.0).reshape(2, 3).astype("f"))
+    out = nd.zeros((2, 3))
+    r = mx.nd.softmax(x, out=out)
+    assert r is out
+    np.testing.assert_allclose(out.asnumpy(), x.softmax().asnumpy(),
+                               rtol=1e-6)
+
+
+def test_rng_fresh_on_cache_hits():
+    """needs_rng ops thread the PRNG key as an argument: cache hits must
+    still draw fresh randomness, and seeded streams must match jit-off."""
+    mx.random.seed(11)
+    a = mx.random.uniform(shape=(8,)).asnumpy()
+    b = mx.random.uniform(shape=(8,)).asnumpy()
+    assert not np.array_equal(a, b)  # a hit did not replay the same draw
+    mx.random.seed(11)
+    nd.set_eager_jit(False)
+    a_off = mx.random.uniform(shape=(8,)).asnumpy()
+    b_off = mx.random.uniform(shape=(8,)).asnumpy()
+    nd.set_eager_jit(True)
+    np.testing.assert_array_equal(a, a_off)
+    np.testing.assert_array_equal(b, b_off)
+
+
+def test_trace_unsafe_op_falls_back_and_blocklists():
+    """An op whose body cannot trace (concrete value use) runs eagerly,
+    lands on the blocklist, and keeps working forever after."""
+    from mxnet_tpu.ops.registry import register, OP_TABLE
+
+    name = "_test_trace_unsafe_op"
+    if name not in OP_TABLE:
+        @register(name, differentiable=False)
+        def _unsafe(x):
+            import numpy as onp
+
+            return x + float(onp.asarray(x).sum())  # concretizes under jit
+
+    x = nd.array(np.ones((3,), "f"))
+    r1 = nd.invoke(name, [x], {})
+    np.testing.assert_allclose(r1.asnumpy(), np.full((3,), 4.0), rtol=1e-6)
+    assert name in nd.dispatch_stats()["blocklisted"]
+    r2 = nd.invoke(name, [x], {})  # second call: straight eager, no retry
+    np.testing.assert_allclose(r2.asnumpy(), np.full((3,), 4.0), rtol=1e-6)
+
+
+def test_unhashable_attrs_bypass():
+    from mxnet_tpu.ops.registry import register, OP_TABLE
+
+    name = "_test_array_attr_op"
+    if name not in OP_TABLE:
+        @register(name, differentiable=False)
+        def _arr_attr(x, weights=None):
+            import jax.numpy as jnp
+
+            return x * jnp.asarray(weights)
+
+    x = nd.array(np.ones((3,), "f"))
+    dc.reset_stats()
+    r = nd.invoke(name, [x], {"weights": np.array([1.0, 2.0, 3.0], "f")})
+    np.testing.assert_allclose(r.asnumpy(), [1.0, 2.0, 3.0], rtol=1e-6)
+    s = nd.dispatch_stats()
+    assert s["bypasses"] >= 1 and s["misses"] == 0
+
+
+def test_naive_engine_bypasses_cache():
+    from mxnet_tpu import engine
+
+    x = nd.array(np.random.RandomState(5).randn(2, 6).astype("f"))
+    warm = x.softmax().asnumpy()
+    try:
+        engine.set_engine_type("NaiveEngine")
+        dc.reset_stats()
+        naive = x.softmax().asnumpy()
+        s = nd.dispatch_stats()
+        assert not s["enabled"]
+        assert s["hits"] == 0
+    finally:
+        engine.set_engine_type("ThreadedEnginePerDevice")
+    np.testing.assert_array_equal(warm, naive)
+    assert nd.dispatch_stats()["enabled"]
+
+
+def test_nan_check_interplay():
+    from mxnet_tpu import engine
+    from mxnet_tpu.base import MXNetError
+
+    x = nd.array(np.zeros((3,), "f"))
+    try:
+        engine.set_nan_check(True)
+        with pytest.raises(MXNetError, match="nan_check"):
+            mx.nd.log(x)  # log(0) = -inf, via the jit fast path
+    finally:
+        engine.set_nan_check(False)
+
+
+def test_multi_output_op_cached():
+    x = nd.array(np.random.RandomState(6).randn(2, 8, 4).astype("f"))
+    g = nd.array(np.ones(8, "f"))
+    b = nd.array(np.zeros(8, "f"))
+    rm = nd.array(np.zeros(8, "f"))
+    rv = nd.array(np.ones(8, "f"))
+    dc.reset_stats()
+    o1 = mx.nd.BatchNorm(x, g, b, rm, rv, training=False)
+    o2 = mx.nd.BatchNorm(x, g, b, rm, rv, training=False)
+    assert len(o1) == 3
+    s = nd.dispatch_stats()["per_op"]["BatchNorm"]
+    assert s["misses"] == 1 and s["hits"] == 1
+    np.testing.assert_array_equal(o1[0].asnumpy(), o2[0].asnumpy())
+
+
+def test_creation_ops_cached():
+    dc.reset_stats()
+    a = nd.zeros((5, 5))
+    b = nd.zeros((5, 5))
+    assert np.all(a.asnumpy() == 0) and np.all(b.asnumpy() == 0)
+    s = nd.dispatch_stats()["per_op"].get("zeros")
+    assert s and s["hits"] >= 1
+
+
+def test_alias_stats_match_call_site_name():
+    """Per-op counters key on the name the caller used (so they line up
+    with the profiler's rows) while aliases still share one executable."""
+    x = nd.array(np.ones((2, 3), "f"))
+    dc.reset_stats()
+    mx.nd.Activation(x, act_type="relu")
+    mx.nd.Activation(x, act_type="relu")
+    mx.nd.activation(x, act_type="relu")  # alias of the same OpDef
+    per = nd.dispatch_stats()["per_op"]
+    assert per["Activation"] == {"hits": 1, "misses": 1, "bypasses": 0}
+    # alias hits the entry the canonical name compiled: shared executable
+    assert per["activation"] == {"hits": 1, "misses": 0, "bypasses": 0}
+
+
+def test_attr_key_distinguishes_hash_equal_values():
+    """0.0 / -0.0 / 2 / 2.0 / True hash equal in Python but compile to
+    different constants — each must get its own executable (review
+    finding: clip(-0.0) served the clip(0.0) call)."""
+    x = nd.array(np.array([-5.0, 3.0], "f"))
+    neg = mx.nd.clip(x, -0.0, 10.0).asnumpy()
+    pos = mx.nd.clip(x, 0.0, 10.0).asnumpy()
+    assert np.signbit(neg[0]) and not np.signbit(pos[0])
+    s = nd.dispatch_stats()["per_op"]["clip"]
+    assert s["misses"] == 2 and s["hits"] == 0
+    # int vs float scalar attrs compile separately too
+    dc.clear()
+    dc.reset_stats()
+    i = nd.invoke("clip", [x], {"a_min": 0, "a_max": 10})
+    f = nd.invoke("clip", [x], {"a_min": 0.0, "a_max": 10.0})
+    assert nd.dispatch_stats()["per_op"]["clip"]["misses"] == 2
+    np.testing.assert_allclose(i.asnumpy(), f.asnumpy())
+
+
+def test_trace_failure_is_per_key_not_per_op():
+    """A trace failure confines the eager fallback to the failing (attrs,
+    avals) variant: other variants of the same op keep the jit fast path,
+    and the op-wide block only engages after several distinct failures."""
+    from mxnet_tpu.ops.registry import register, OP_TABLE
+
+    name = "_test_partial_unsafe_op"
+    if name not in OP_TABLE:
+        @register(name, differentiable=False)
+        def _partial(x, concrete=False):
+            if concrete:
+                return x + float(np.asarray(x).sum())  # breaks under trace
+            return x + 1.0
+
+    x = nd.array(np.ones((3,), "f"))
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        bad = nd.invoke(name, [x], {"concrete": True})   # fails, eager
+    np.testing.assert_allclose(bad.asnumpy(), np.full((3,), 4.0), rtol=1e-6)
+    dc.reset_stats()
+    good1 = nd.invoke(name, [x], {"concrete": False})    # still jittable
+    good2 = nd.invoke(name, [x], {"concrete": False})
+    per = nd.dispatch_stats()["per_op"][name]
+    assert per["misses"] == 1 and per["hits"] == 1       # fast path kept
+    np.testing.assert_allclose(good2.asnumpy(), np.full((3,), 2.0),
+                               rtol=1e-6)
+    # the failing variant is served from its cached eager entry (a hit)
+    bad2 = nd.invoke(name, [x], {"concrete": True})
+    np.testing.assert_allclose(bad2.asnumpy(), np.full((3,), 4.0), rtol=1e-6)
+    assert name in nd.dispatch_stats()["blocklisted"]    # reported

@@ -191,3 +191,127 @@ def test_int8_round_trip_preserves_dtype():
     g = nd.array(np.ones((3,)), dtype="bfloat16")
     rt = kv._compression.round_trip(g)
     assert rt.dtype == np.dtype(ml_dtypes.bfloat16)
+
+
+def test_mixed_dense_push_row_sparse_pull_no_thrash():
+    """ADVICE r5 #1 regression: a dense-traffic key alternating dense
+    pushes with row_sparse_pulls must NOT promote/demote a host table per
+    step — it stays on the device-side take path after dense traffic is
+    seen, with results identical throughout."""
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.kvstore import _HostRowSparseTable
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    kv = kvstore.create('local')
+    kv.set_optimizer(opt.SGD(learning_rate=0.5))
+    w0 = np.arange(12.0).reshape(4, 3).astype("f")
+    kv.init('w', nd.array(w0))
+
+    expect = w0.copy()
+    for _ in range(3):
+        kv.push('w', [nd.ones((4, 3))])       # dense grad: w -= 0.5
+        expect -= 0.5
+        out = nd.zeros((2, 3))
+        kv.row_sparse_pull('w', out=out, row_ids=nd.array([0, 2]))
+        assert_almost_equal(out, expect[[0, 2]])
+    # dense-only traffic: the key must have stayed device-resident
+    assert isinstance(kv._store['w'], NDArray)
+    assert not isinstance(kv._store['w'], _HostRowSparseTable)
+
+
+def test_sparse_push_history_survives_demote():
+    """A key whose traffic is genuinely mixed keeps its sparse-push count
+    across promote/demote, so once any row-sparse push has been seen a
+    dense gradient takes the in-place host update instead of demoting."""
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.kvstore import _HostRowSparseTable
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+
+    kv = kvstore.create('local')
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.5))
+    kv.init('e', nd.zeros((6, 2)))
+    # promote via pull (no dense traffic yet -> allowed), then demote on
+    # the first dense grad (no sparse push seen)
+    out = nd.zeros((1, 2))
+    kv.row_sparse_pull('e', out=out, row_ids=nd.array([1]))
+    assert isinstance(kv._store['e'], _HostRowSparseTable)
+    kv.push('e', [nd.ones((6, 2))])
+    assert isinstance(kv._store['e'], NDArray)  # demoted
+    # a row-sparse push re-promotes and marks the key's history
+    g = row_sparse_array((np.ones((2, 2), "f"), [1, 4]), shape=(6, 2))
+    kv.push('e', g)
+    host = kv._store['e']
+    assert isinstance(host, _HostRowSparseTable)
+    assert host.sparse_pushes >= 1
+    # mixed key now: a dense grad updates in place, NOT a demote
+    kv.push('e', [nd.ones((6, 2))])
+    assert kv._store['e'] is host
+    # and row_sparse_pull serves host-side rows
+    kv.row_sparse_pull('e', out=out, row_ids=nd.array([1]))
+    assert_almost_equal(out, host.table[[1]])
+
+
+def test_optimizer_states_format_header():
+    """Bundled optimizer-state files carry the explicit MXKVOPT1 magic;
+    plain updater blobs stay raw — no speculative unpickling either way."""
+    import os
+    import tempfile
+
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+
+    with tempfile.TemporaryDirectory() as d:
+        plain, bundled = os.path.join(d, "p.st"), os.path.join(d, "b.st")
+        kv = kvstore.create('local')
+        kv.set_optimizer(opt.create("sgd", learning_rate=0.5, momentum=0.9))
+        kv.init('w', nd.zeros((4, 2)))
+        kv.push('w', [nd.ones((4, 2))])
+        kv.save_optimizer_states(plain)
+        with open(plain, "rb") as f:
+            assert not f.read().startswith(b"MXKVOPT1")
+
+        g = row_sparse_array((np.ones((1, 2), "f"), [2]), shape=(4, 2))
+        kv.push('w', g)                     # host state appears
+        kv.save_optimizer_states(bundled)
+        with open(bundled, "rb") as f:
+            assert f.read().startswith(b"MXKVOPT1")
+
+        # both variants load into a fresh store
+        for fname in (plain, bundled):
+            kv2 = kvstore.create('local')
+            kv2.set_optimizer(opt.create("sgd", learning_rate=0.5,
+                                         momentum=0.9))
+            kv2.init('w', nd.zeros((4, 2)))
+            kv2.load_optimizer_states(fname)
+
+
+def test_optimizer_states_legacy_bundled_format_loads():
+    """Files written by the pre-MXKVOPT1 build (bare pickled wrapper dict)
+    must still load: updater blob adopted, host states not dropped."""
+    import os
+    import pickle
+    import tempfile
+
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "legacy.st")
+        kv = kvstore.create('local')
+        kv.set_optimizer(opt.create("sgd", learning_rate=0.5, momentum=0.9))
+        kv.init('w', nd.zeros((4, 2)))
+        g = row_sparse_array((np.ones((1, 2), "f"), [2]), shape=(4, 2))
+        kv.push('w', g)
+        blob = kv._updater.get_states(False)
+        host = {k: v.state for k, v in kv._store.items()
+                if hasattr(v, "state") and v.state is not None}
+        assert host
+        with open(fname, "wb") as f:  # the old magic-less wrapper layout
+            f.write(pickle.dumps({"__kv_host_states__": host,
+                                  "updater": blob}))
+        kv2 = kvstore.create('local')
+        kv2.set_optimizer(opt.create("sgd", learning_rate=0.5, momentum=0.9))
+        kv2.init('w', nd.zeros((4, 2)))
+        kv2.load_optimizer_states(fname)
+        assert kv2._pending_host_state  # host states adopted, not dropped
