@@ -1,0 +1,63 @@
+"""Chaos-lane smoke: exercise the fault harness from an AMBIENT env spec.
+
+Run by ci/runtest.sh chaos as:
+
+    MXNET_FAULT_SPEC=checkpoint.write:fail:1 python ci/chaos_smoke.py
+
+A supervised training loop (meta-only checkpoints — no net, so the smoke
+is seconds, not minutes) must absorb the injected first-write failure via
+run_with_recovery and finish all steps; the trip must show up in
+fault.stats() and the profiler table.  This keeps the env-spec arming
+path itself exercised in CI — the pytest suite arms faults through
+monkeypatched env + inject(), which would let a regression in ambient
+spec pickup slip through.
+"""
+import os
+import sys
+import tempfile
+
+# the script lives in ci/; the repo root is the import root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_FAULT_BACKOFF_MS", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import fault, profiler  # noqa: E402
+from mxnet_tpu.checkpoint import CheckpointManager, run_with_recovery  # noqa: E402
+
+
+def main():
+    spec = os.environ.get("MXNET_FAULT_SPEC", "")
+    if "checkpoint.write" not in spec:
+        sys.exit("chaos_smoke: expected an ambient MXNET_FAULT_SPEC arming "
+                 f"checkpoint.write (got {spec!r})")
+    mgr = CheckpointManager(tempfile.mkdtemp(prefix="chaos_smoke_"))
+    attempts = []
+
+    def train(start, manager):
+        attempts.append(start)
+        for step in range(start, 3):
+            manager.save(step + 1, extra={"attempt": len(attempts)})
+        return "done"
+
+    result = run_with_recovery(train, mgr, max_restarts=2)
+    stats = fault.stats()["checkpoint.write"]
+    assert result == "done", result
+    assert len(attempts) == 2, attempts          # one restart happened
+    assert mgr.latest_step() == 3, mgr.all_steps()
+    assert mgr.restore() == 3                    # resumes from a valid step
+    assert stats["trips"] == 1, stats            # the env spec armed it
+    table = profiler.dumps()
+    assert "checkpoint.write" in table
+    print(f"chaos_smoke OK: spec={spec!r} attempts={attempts} "
+          f"steps={mgr.all_steps()} checkpoint.write={stats}")
+
+
+if __name__ == "__main__":
+    main()

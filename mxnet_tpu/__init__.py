@@ -74,6 +74,7 @@ from . import subgraph
 from . import numpy as np  # mx.np — NumPy-compatible namespace
 from . import numpy_extension as npx
 from . import env
+from . import fault
 
 env.apply_env()
 from . import parallel

@@ -10,28 +10,52 @@ restarts together).
 Design:
 - ``CheckpointManager``: step-indexed directory layout, ATOMIC publishes
   (write to tmp, fsync, rename — a partially-written checkpoint is never
-  visible), bounded retention, ``latest_step()`` discovery for resume.
+  visible), per-file sha256 checksums recorded in ``meta.json`` and
+  verified on restore (a bit-flipped or truncated file is detected, the
+  step is skipped, and restore falls back to the newest VALID older
+  step), bounded retention, ``latest_step()`` discovery for resume, and
+  orphaned-staging GC (a crash mid-save leaves a ``.tmp_step_*`` dir; the
+  next manager construction sweeps them).
   In a multi-process job only process 0 writes (weights are replicated);
   all processes barrier on publish so no one resumes past a checkpoint a
   peer has not finished.
 - ``run_with_recovery``: restarts a training function from the latest
   checkpoint after transient failures (preemption, XLA OOM after
-  defragmentation, flaky interconnect) with bounded retries.
+  defragmentation, flaky interconnect) with exponential backoff + jitter
+  between restarts and a restart budget that RESETS whenever the job made
+  checkpoint progress between failures — a job that keeps advancing is
+  healthy no matter how often it is preempted, while a crash loop at the
+  same step still exhausts the budget.
+
+Failure domains are exercised through :mod:`mxnet_tpu.fault` (seams
+``checkpoint.write`` / ``checkpoint.fsync`` / ``checkpoint.publish``);
+see tests/test_fault.py for the chaos suite.
 """
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
 import shutil
 import tempfile
 import time
 
+from . import fault
 from .base import MXNetError
 
 __all__ = ["CheckpointManager", "run_with_recovery"]
 
+_LOGGER = logging.getLogger(__name__)
+
+_TMP_PREFIX = ".tmp_step_"
+# files that never get a checksum: meta.json carries the sums, COMMITTED
+# is the marker itself
+_UNSUMMED = ("meta.json", "COMMITTED")
+
 
 def _fsync_file(path):
+    fault.check("checkpoint.fsync")
     fd = os.open(path, os.O_RDONLY)
     try:
         os.fsync(fd)
@@ -50,6 +74,24 @@ def _fsync_dir(path):
         os.close(fd)
 
 
+def _sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _stat_sig(path):
+    """(size, mtime_ns) fingerprint, or None when missing — cheap change
+    detector for the verify() verdict cache."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_size, st.st_mtime_ns)
+
+
 class CheckpointManager:
     """Atomic, step-indexed checkpoints for Gluon nets + Trainers.
 
@@ -62,10 +104,67 @@ class CheckpointManager:
             mgr.save(epoch + 1, net, trainer)
     """
 
-    def __init__(self, directory, max_to_keep=5):
+    def __init__(self, directory, max_to_keep=5, logger=None):
         self.directory = directory
         self.max_to_keep = max_to_keep
+        self.logger = logger or _LOGGER
+        # verify() verdict cache: step -> {file: (size, mtime_ns)} at the
+        # time the step last hashed clean
+        self._valid_steps = {}
+        # steps that VERIFIED clean but failed to load (pre-checksum
+        # checkpoint with a torn file): latest_valid_step must skip them
+        # or the next restart's start step disagrees with the weights
+        # restore() actually falls back to
+        self._load_failed = set()
         os.makedirs(directory, exist_ok=True)
+        # only the writing process sweeps: a non-primary peer constructing
+        # its manager while process 0 is mid-save must not delete the live
+        # staging dir out from under it.  The rank comes from the LAUNCHER
+        # env, not jax.process_index(): constructing a manager must not
+        # initialize the jax backend (that would break a later
+        # jax.distributed.initialize), and before initialization every
+        # process would report index 0 anyway.
+        if self._launcher_rank() == 0:
+            self._gc_orphaned_tmp()
+
+    @staticmethod
+    def _launcher_rank():
+        """Process rank WITHOUT initializing the jax backend; -1 = multi-
+        process job whose rank cannot be proven (callers fail closed)."""
+        for var in ("MXNET_WORKER_ID", "DMLC_WORKER_ID", "TPU_WORKER_ID",
+                    "CLOUD_TPU_TASK_ID"):
+            v = os.environ.get(var)
+            if v:
+                try:
+                    return int(v)
+                except ValueError:
+                    return -1  # unparseable: cannot prove primary
+        from .parallel import distributed as _dist
+
+        if _dist.is_initialized():
+            import jax   # already initialized: reading the index is safe
+
+            return jax.process_index()
+        if os.environ.get("MXNET_COORDINATOR_ADDRESS") or \
+                os.environ.get("DMLC_PS_ROOT_URI"):
+            # a coordinator is configured but no rank var and not yet
+            # initialized: this IS a multi-process job — fail closed
+            # rather than risk every peer sweeping the shared directory
+            return -1
+        return 0  # single-process / un-launched
+
+    def _gc_orphaned_tmp(self):
+        """Sweep ``.tmp_step_*`` staging dirs left by a crash mid-save
+        (they were never published, so deleting them is always safe —
+        an in-flight save in ANOTHER process is the operator's error:
+        two writers on one checkpoint dir corrupt retention anyway)."""
+        for name in os.listdir(self.directory):
+            path = os.path.join(self.directory, name)
+            if name.startswith(_TMP_PREFIX) and os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+                self.logger.warning(
+                    "removed orphaned checkpoint staging dir %s "
+                    "(crash mid-save)", path)
 
     # -- discovery ---------------------------------------------------------
     def _step_dir(self, step):
@@ -85,6 +184,56 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def latest_valid_step(self):
+        """Newest step that passes checksum verification and has not been
+        seen to fail a load — the step a restore() will actually serve.
+        Resume logic must use THIS, not ``latest_step()``: after
+        corruption the two differ, and trusting the unverified number
+        silently skips the corrupted step's work."""
+        for s in reversed(self.all_steps()):
+            if s not in self._load_failed and self.verify(s) is None:
+                return s
+        return None
+
+    def verify(self, step):
+        """Integrity-check checkpoint ``step`` against the checksums in
+        its meta.json.  Returns None when valid, else a string naming the
+        first problem.  Checkpoints written before checksums existed
+        (no "files" key) verify as valid — there is nothing to check.
+
+        A VALID verdict is cached against each file's (size, mtime_ns) —
+        resume would otherwise sha256 a multi-GB checkpoint twice
+        (latest_valid_step, then restore).  Any stat change voids the
+        cache and re-hashes; failures are never cached, so an operator
+        who repairs a file in place is believed."""
+        d = self._step_dir(step)
+        cached = self._valid_steps.get(step)
+        if cached is not None:
+            if all(_stat_sig(os.path.join(d, n)) == sig
+                   for n, sig in cached.items()):
+                return None
+            del self._valid_steps[step]
+        if not os.path.exists(os.path.join(d, "COMMITTED")):
+            return f"{d}: no COMMITTED marker"
+        try:
+            with open(os.path.join(d, "meta.json")) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            return f"{d}/meta.json unreadable: {e}"
+        for name, want in (meta.get("files") or {}).items():
+            path = os.path.join(d, name)
+            if not os.path.exists(path):
+                return f"{path}: missing"
+            if os.path.getsize(path) != want["size"]:
+                return (f"{path}: size {os.path.getsize(path)} != recorded "
+                        f"{want['size']} (truncated?)")
+            if _sha256(path) != want["sha256"]:
+                return f"{path}: sha256 mismatch (corrupt)"
+        self._valid_steps[step] = {
+            name: _stat_sig(os.path.join(d, name))
+            for name in (meta.get("files") or {})}
+        return None
+
     # -- save/restore ------------------------------------------------------
     def save(self, step, net=None, trainer=None, extra=None):
         """Publish checkpoint `step` atomically; returns its directory."""
@@ -94,9 +243,10 @@ class CheckpointManager:
         final = self._step_dir(step)
         try:
             if primary:
-                tmp = tempfile.mkdtemp(prefix=f".tmp_step_{step}_",
+                tmp = tempfile.mkdtemp(prefix=f"{_TMP_PREFIX}{step}_",
                                        dir=self.directory)
                 try:
+                    fault.check("checkpoint.write")
                     if net is not None:
                         net.save_parameters(
                             os.path.join(tmp, "model.params"))
@@ -106,6 +256,13 @@ class CheckpointManager:
                     meta = {"step": int(step), "time": time.time()}
                     if extra:
                         meta["extra"] = extra
+                    # integrity: restore() re-hashes each payload file
+                    # against these sums before trusting the step
+                    meta["files"] = {
+                        name: {"sha256": _sha256(os.path.join(tmp, name)),
+                               "size": os.path.getsize(
+                                   os.path.join(tmp, name))}
+                        for name in os.listdir(tmp) if name not in _UNSUMMED}
                     with open(os.path.join(tmp, "meta.json"), "w") as f:
                         json.dump(meta, f)
                     # durability: every payload file reaches the platter
@@ -118,8 +275,11 @@ class CheckpointManager:
                         f.flush()
                         os.fsync(f.fileno())
                     _fsync_dir(tmp)
+                    fault.check("checkpoint.publish")
                     if os.path.exists(final):
                         shutil.rmtree(final)
+                    self._valid_steps.pop(step, None)  # content changes now
+                    self._load_failed.discard(step)
                     os.rename(tmp, final)
                     _fsync_dir(self.directory)
                 except Exception:
@@ -133,21 +293,63 @@ class CheckpointManager:
         return final
 
     def restore(self, net=None, trainer=None, step=None, ctx=None):
-        """Load the latest (or given) checkpoint; returns the step number,
-        or 0 when no checkpoint exists yet."""
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            return 0
+        """Load the newest VALID checkpoint (default), or exactly ``step``
+        when one is requested explicitly; returns the loaded step number,
+        or 0 when no valid checkpoint exists.
+
+        With ``step=None`` a checkpoint whose files fail checksum
+        verification — or whose load raises — is skipped with a warning
+        and the next older step is tried: one corrupt file must cost one
+        checkpoint of progress, not the job.  An EXPLICIT ``step`` keeps
+        the strict contract: the caller pinned that checkpoint
+        (reproduction run, eval of a named step), so serving different
+        weights would be silent corruption — missing or invalid raises."""
+        if step is not None:
+            if step not in self.all_steps():
+                raise MXNetError(
+                    f"checkpoint {self._step_dir(step)} is not committed")
+            problem = self.verify(step)
+            if problem is not None:
+                raise MXNetError(
+                    f"checkpoint step {step} requested explicitly but "
+                    f"failed verification: {problem}")
+            self._load(step, net, trainer, ctx)
+            return step
+        for s in reversed(self.all_steps()):
+            if s in self._load_failed:
+                # stays skipped for this manager's lifetime even if the
+                # failure was transient: latest_valid_step() skips it, so
+                # loading it here would hand back step-s weights while
+                # the supervisor already told train_fn to start at s-1
+                continue
+            problem = self.verify(s)
+            if problem is not None:
+                self.logger.warning(
+                    "checkpoint step %d failed verification (%s); "
+                    "falling back to an older step", s, problem)
+                continue
+            try:
+                self._load(s, net, trainer, ctx)
+            except Exception as e:  # checksum passed but load failed:
+                # treat like corruption (e.g. pre-checksum checkpoint
+                # with a torn file) and keep walking back; remember the
+                # step so latest_valid_step stops advertising it
+                self._load_failed.add(s)
+                self.logger.warning(
+                    "checkpoint step %d failed to load (%r); "
+                    "falling back to an older step", s, e)
+                continue
+            return s
+        return 0
+
+    def _load(self, step, net, trainer, ctx):
         d = self._step_dir(step)
-        if not os.path.exists(os.path.join(d, "COMMITTED")):
-            raise MXNetError(f"checkpoint {d} is not committed")
         if net is not None:
             net.load_parameters(os.path.join(d, "model.params"), ctx=ctx)
         if trainer is not None:
             tpath = os.path.join(d, "trainer.states")
             if os.path.exists(tpath):
                 trainer.load_states(tpath)
-        return step
 
     def read_meta(self, step):
         with open(os.path.join(self._step_dir(step), "meta.json")) as f:
@@ -157,6 +359,8 @@ class CheckpointManager:
         steps = self.all_steps()
         for s in steps[:-self.max_to_keep] if self.max_to_keep else []:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            self._valid_steps.pop(s, None)   # week-long jobs: no leak
+            self._load_failed.discard(s)
 
     def _barrier(self):
         import jax
@@ -168,16 +372,38 @@ class CheckpointManager:
 
 
 def run_with_recovery(train_fn, manager, max_restarts=3,
-                      should_retry=None, logger=None):
+                      should_retry=None, logger=None, backoff_ms=None):
     """Supervised training loop: ``train_fn(start_step, manager)`` runs to
     completion or raises; on a retryable failure it is re-invoked from the
     latest checkpoint (elastic semantics for preemptible TPU jobs).
 
-    ``should_retry(exc) -> bool`` filters failures (default: retry
-    everything except KeyboardInterrupt).  Returns train_fn's result."""
+    - ``should_retry(exc) -> bool`` filters failures (default: retry
+      everything except KeyboardInterrupt).
+    - Restarts back off exponentially with full jitter (seed
+      ``backoff_ms``, default MXNET_FAULT_BACKOFF_MS=100, capped at 30s)
+      so a fleet of preempted workers does not re-stampede the
+      coordinator.
+    - The restart budget (``max_restarts``) counts CONSECUTIVE failures
+      at the same checkpoint step: whenever ``manager.latest_step()``
+      advanced since the previous failure the budget resets, so a
+      long-running job survives unlimited preemptions as long as it keeps
+      making progress.
+    - Restart telemetry always reaches a logger — the module logger when
+      ``logger`` is None — so silent restart loops show up in production
+      logs.
+
+    Returns train_fn's result."""
+    log = logger or _LOGGER
+    if backoff_ms is None:
+        backoff_ms = fault.backoff_ms()
+    # resume from the newest VERIFIED step: latest_step() would count a
+    # corrupt checkpoint that restore() will skip, telling train_fn to
+    # start past state it never loaded (silent step/state skew)
+    progress = getattr(manager, "latest_valid_step", manager.latest_step)
     restarts = 0
+    last_failed_step = None
     while True:
-        start = manager.latest_step() or 0
+        start = progress() or 0
         try:
             return train_fn(start, manager)
         except KeyboardInterrupt:
@@ -185,12 +411,20 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
         except Exception as e:
             if should_retry is not None and not should_retry(e):
                 raise
+            step_now = progress() or 0
+            if last_failed_step is not None and step_now > last_failed_step:
+                log.info("checkpoint advanced %s -> %s between failures; "
+                         "restart budget reset", last_failed_step, step_now)
+                restarts = 0
+            last_failed_step = step_now
             restarts += 1
             if restarts > max_restarts:
                 raise MXNetError(
                     f"training failed after {max_restarts} restarts "
-                    f"(last error: {e!r})") from e
-            if logger is not None:
-                logger.warning("restart %d/%d from step %s after: %r",
-                               restarts, max_restarts,
-                               manager.latest_step(), e)
+                    f"without checkpoint progress (stuck at step "
+                    f"{step_now}; last error: {e!r})") from e
+            delay = fault.backoff_delay(restarts - 1, backoff_ms)
+            log.warning("restart %d/%d from step %s in %.3fs after: %r",
+                        restarts, max_restarts, step_now, delay, e)
+            if delay > 0:
+                time.sleep(delay)

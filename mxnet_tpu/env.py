@@ -37,6 +37,17 @@ Wired vars (read at ``import mxnet_tpu``):
 - ``MXNET_BENCH_FORCE_SWEEP``: run the TPU-gated bench sweep branches
   (resnet config sweep, flash-block grid) on CPU too, so the sweep and
   headline-selection code paths are exercised before first chip contact.
+- ``MXNET_FAULT_SPEC``: deterministic fault injection —
+  ``<seam>:fail[:times[:Error]]``, comma-separated (e.g.
+  ``checkpoint.write:fail:2``); see :mod:`mxnet_tpu.fault` for the seam
+  list.  Read lazily at the first seam check so spawned DataLoader
+  workers inherit it.
+- ``MXNET_FAULT_MAX_RETRIES``: bounded retry budget for transient errors
+  at the hardened seams (kvstore push/pull, host collectives,
+  distributed.init; default 3).
+- ``MXNET_FAULT_BACKOFF_MS``: first-retry backoff seed in ms (doubles per
+  retry, full jitter, 30s cap; default 100).  Also seeds the
+  between-restart backoff of ``checkpoint.run_with_recovery``.
 
 Accepted-but-subsumed (XLA owns the concern; reads return the default and
 ``describe()`` says why):
@@ -129,6 +140,12 @@ def describe():
         ("MXNET_MP_START_METHOD", "DataLoader process-worker start method "
          "(default spawn)"),
         ("MXNET_BENCH_FORCE_SWEEP", "run TPU-gated bench sweeps on CPU"),
+        ("MXNET_FAULT_SPEC", "deterministic fault injection spec "
+         "(<seam>:fail[:times[:Error]], comma-separated; mxnet_tpu.fault)"),
+        ("MXNET_FAULT_MAX_RETRIES", "transient-error retry budget at "
+         "hardened seams (default 3)"),
+        ("MXNET_FAULT_BACKOFF_MS", "retry/restart backoff seed in ms "
+         "(default 100; doubles per retry, full jitter)"),
     ]
     for name, what in wired:
         lines.append(f"{name}={os.environ.get(name, '<unset>')} — {what}")

@@ -53,10 +53,14 @@ def default_mp_batchify_fn(data):
 
 
 _worker_dataset = None
+# set in the CHILD when the jax CPU pin failed there: a mis-pinned worker
+# can grab the TPU runtime, and the symptom (a wedged axon tunnel or an
+# OOM half an epoch later) otherwise never points back to this cause
+_worker_pin_error = None
 
 
 def _worker_initializer(dataset):
-    global _worker_dataset
+    global _worker_dataset, _worker_pin_error
     _worker_dataset = dataset
     # pin any jax use in this child to CPU BEFORE its first dispatch (env
     # alone is not enough where a sitecustomize force-selects the platform
@@ -66,11 +70,23 @@ def _worker_initializer(dataset):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    except Exception as e:
+        import logging
+        import os as _os
+
+        _worker_pin_error = f"{type(e).__name__}: {e}"
+        logging.getLogger(__name__).warning(
+            "DataLoader worker pid=%d: jax CPU pin failed (%s) — this "
+            "child may initialize the device runtime", _os.getpid(),
+            _worker_pin_error)
 
 
-def _terminate_pool(pool):
+def _terminate_pool(pool, stops=()):
+    # unblock any active epoch's gated() generator FIRST: the pool's
+    # task-handler thread sits inside it, and terminate() joins that
+    # thread — without the stop signal the join deadlocks
+    for s in list(stops):
+        s.set()
     pool.terminate()
     pool.join()
 
@@ -82,7 +98,21 @@ class _WorkerFn:
         self._fn = batchify_fn
 
     def __call__(self, batch):
-        return self._fn([_worker_dataset[i] for i in batch])
+        from ... import fault
+
+        # seam is armed via MXNET_FAULT_SPEC (the env reaches spawn
+        # children) — in-process inject() plans do not cross the fork
+        fault.check("dataloader.worker")
+        try:
+            return self._fn([_worker_dataset[i] for i in batch])
+        except Exception as e:
+            if _worker_pin_error is not None:
+                # the pickled traceback loses child-side logs; carry the
+                # pin diagnosis inside the exception that crosses back
+                raise RuntimeError(
+                    f"{type(e).__name__}: {e} [worker jax CPU pin had "
+                    f"failed: {_worker_pin_error}]") from e
+            raise
 
 
 def _to_nd(out):
@@ -118,6 +148,7 @@ class DataLoader:
         self._proc_pool = None          # persistent process pool (spawn is
         self._proc_pool_method = None   # expensive: pay startup once)
         self._pool_finalizer = None
+        self._active_stops = set()      # stop events of live epoch iters
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -155,6 +186,10 @@ class DataLoader:
         epoch): workers snapshot the dataset once at pool creation, so
         in-place dataset mutations between epochs are not visible to
         process workers — build a new DataLoader for a new dataset."""
+        import multiprocessing as mp
+
+        from ...base import MXNetError
+
         fn = self._batchify_fn
         if fn is default_batchify_fn:
             fn = default_mp_batchify_fn
@@ -166,6 +201,9 @@ class DataLoader:
         # of the persistent pool).
         sem = threading.BoundedSemaphore(self._num_workers + self._prefetch)
         stop = threading.Event()
+        # registered so close()/pool teardown can unblock gated() even
+        # when this generator was abandoned without being closed
+        self._active_stops.add(stop)
 
         def gated():
             for b in self._batch_sampler:
@@ -176,12 +214,55 @@ class DataLoader:
                     return
                 yield b
 
+        # liveness snapshot: Pool's maintenance thread silently replaces a
+        # dead worker in pool._pool, but the batch the casualty held never
+        # completes — a blind `for out in imap(...)` then hangs forever.
+        # Holding the ORIGINAL Process objects lets the poll below see the
+        # death (exitcode flips non-None; workers never exit on their own
+        # while the pool lives, so any exit mid-epoch is abnormal).
+        workers = list(pool._pool)
+        it = pool.imap(_WorkerFn(fn), gated())
+        idx = 0
         try:
-            for out in pool.imap(_WorkerFn(fn), gated()):
+            while True:
+                try:
+                    out = it.next(timeout=0.2)
+                except StopIteration:
+                    break
+                except mp.TimeoutError:
+                    dead = [p for p in workers if p.exitcode is not None]
+                    if dead:
+                        # the pool's task bookkeeping is now unknowable
+                        # (the dead child's in-flight batch is lost);
+                        # discard it so the NEXT epoch gets clean workers.
+                        # stop MUST be set before teardown: the pool's
+                        # task-handler thread is inside gated() and the
+                        # teardown joins it
+                        stop.set()
+                        self._abandon_proc_pool()
+                        raise MXNetError(
+                            "DataLoader process worker(s) died while "
+                            f"computing batch {idx}: "
+                            + ", ".join(f"pid={p.pid} exitcode={p.exitcode}"
+                                        for p in dead)
+                            + " (killed by the OOM killer or a signal?); "
+                            "the worker pool was recycled — re-iterate to "
+                            "respawn workers")
+                    continue
+                except MXNetError:
+                    raise
+                except Exception as e:
+                    # worker-side failure pickled back through imap: name
+                    # the batch so the bad sample/transform is findable
+                    raise MXNetError(
+                        f"DataLoader worker failed on batch {idx}: "
+                        f"{type(e).__name__}: {e}") from e
                 sem.release()
                 yield _to_nd(out)
+                idx += 1
         finally:
             stop.set()
+            self._active_stops.discard(stop)
 
     def _get_proc_pool(self):
         import multiprocessing as mp
@@ -208,17 +289,46 @@ class DataLoader:
         self._proc_pool_method = method
         # terminate workers when the loader is garbage collected (or at
         # interpreter exit) — __del__ alone is not reliable enough for
-        # child processes
+        # child processes.  The finalizer carries the stop-event set (no
+        # strong ref back to self) so a teardown that fires while an
+        # epoch iterator is still alive does not deadlock on the
+        # task-handler join.
         self._pool_finalizer = weakref.finalize(
-            self, _terminate_pool, pool)
+            self, _terminate_pool, pool, self._active_stops)
         return pool
 
     def _shutdown_proc_pool(self):
+        for s in list(self._active_stops):
+            s.set()   # see _terminate_pool: unblock gated() before join
         if self._pool_finalizer is not None:
             self._pool_finalizer()  # terminates + joins, idempotent
             self._pool_finalizer = None
         self._proc_pool = None
         self._proc_pool_method = None
+
+    def _abandon_proc_pool(self):
+        """Discard a pool poisoned by an abnormal worker death.  A
+        SIGKILLed child may have died holding a shared queue lock, so the
+        orderly terminate+join of ``_shutdown_proc_pool`` can deadlock
+        the parent: instead detach the finalizer (it must not re-run the
+        blocking teardown at GC/exit), hard-kill the remaining children,
+        and run the blocking teardown on a daemon thread — the iterator
+        raises immediately and interpreter exit is never held hostage."""
+        pool = self._proc_pool
+        if pool is None:
+            return
+        if self._pool_finalizer is not None:
+            self._pool_finalizer.detach()
+            self._pool_finalizer = None
+        self._proc_pool = None
+        self._proc_pool_method = None
+        for p in list(pool._pool):
+            try:
+                p.kill()
+            except Exception:  # already reaped
+                pass
+        threading.Thread(target=_terminate_pool, args=(pool,),
+                         daemon=True).start()
 
     def close(self):
         """Release the persistent worker processes now instead of at GC /

@@ -30,6 +30,7 @@ import pickle
 
 import numpy as _np
 
+from . import fault
 from .base import MXNetError, Registry
 from .ndarray.ndarray import NDArray, invoke
 from .ndarray import ndarray as _ndm
@@ -107,8 +108,16 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         """Reduce values (one per device) into the store buffer.
-        Reference: KVStoreLocal::PushImpl -> CommDevice::Reduce."""
+        Reference: KVStoreLocal::PushImpl -> CommDevice::Reduce.
+
+        The entry guard is the host-side transport seam: a transient
+        fault armed (or observed) here is retried with bounded backoff
+        BEFORE any store/updater state mutates, so a retried push never
+        double-applies an update; the network retry for the dist store
+        lives one layer down at ``collectives.allreduce``."""
         from .ndarray.sparse import RowSparseNDArray
+
+        fault.guard("kvstore.push")
 
         keys, grouped = _group_key_value(key, value)
         for k, vals in zip(keys, grouped):
@@ -156,7 +165,9 @@ class KVStore:
                 self._store[k] = reduced
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
-        """Broadcast stored value to every output (≙ CommDevice::Broadcast)."""
+        """Broadcast stored value to every output (≙ CommDevice::Broadcast).
+        Entry guard: see ``push`` — same retry-before-mutation contract."""
+        fault.guard("kvstore.pull")
         keys, grouped = _group_key_value(key, out)
         for k, outs in zip(keys, grouped):
             if k not in self._store:
@@ -563,8 +574,24 @@ class DistTPUSyncKVStore(KVStore):
             self._sharded_update = False
 
     def push(self, key, value, priority=0):
+        from .ndarray.sparse import RowSparseNDArray
+
+        fault.guard("kvstore.push")
         keys, grouped = _group_key_value(key, value)
         reduced_list = [_reduce(vals) for vals in grouped]
+        # record dense traffic like the base store does: the inherited
+        # row_sparse_pull promote gate reads _dense_pushed, and a key it
+        # wrongly promotes would crash this push path (no host-table
+        # branch here)
+        for k, reduced in zip(keys, reduced_list):
+            if not isinstance(reduced, RowSparseNDArray):
+                self._dense_pushed.add(k)
+            if isinstance(self._store.get(k), _HostRowSparseTable):
+                # promoted by a row_sparse_pull that preceded the first
+                # push (never-pushed keys pass the gate): demote back to
+                # a device array, handing any host optimizer state to the
+                # updater, before the dist update path runs
+                self._store[k] = self._demote(k)
         if self.num_workers > 1 and not (
                 getattr(self, "_sharded_update", False)
                 and self._updater is not None):

@@ -123,15 +123,45 @@ def _sum_combine(a, nl):
     return a.sum(axis=0) / nl
 
 
+def _combine_with_seam(local_leaves, combine_fn, static_args=()):
+    """Route a host-value collective through the ``collectives.allreduce``
+    fault seam.  Single-process (tests, _testing_force paths): the full
+    retry policy applies, so injected transient faults are absorbed end
+    to end.  Multi-process SPMD: seam check only, NO local retry — a
+    unilateral re-issue desyncs the peers' collective issue counts (they
+    never issue the matching one, so the retry hangs the mesh); a real
+    transient interconnect failure instead escalates to
+    checkpoint.run_with_recovery, which restarts every process together —
+    bounded backoff at the scope where retry is actually safe."""
+    import jax
+
+    from .. import fault
+
+    if jax.process_count() == 1:
+        return fault.call_with_retries(
+            "collectives.allreduce", _cross_process_combine,
+            local_leaves, combine_fn, static_args=static_args)
+    fault.check("collectives.allreduce")
+    return _cross_process_combine(local_leaves, combine_fn,
+                                  static_args=static_args)
+
+
 def allreduce_hosts(value):
     """Allreduce a host-local array across all processes' devices: builds a
     global array sharded over processes and psums it.  Used by the
-    dist_tpu_sync KVStore (single psum ≙ push+pull, SURVEY.md §4.4)."""
+    dist_tpu_sync KVStore (single psum ≙ push+pull, SURVEY.md §4.4).
+
+    Fault seam ``collectives.allreduce``; see ``_combine_with_seam`` for
+    why transient-error retry happens here only single-process (SPMD
+    retry is run_with_recovery's whole-job restart)."""
     import jax
 
+    from .. import fault
+
     if jax.process_count() == 1:
+        fault.guard("collectives.allreduce")
         return value
-    return _cross_process_combine((value,), _sum_combine)
+    return _combine_with_seam((value,), _sum_combine)
 
 
 def barrier():
@@ -174,11 +204,14 @@ def allreduce_hosts_quantized(value, _testing_force=False):
     """
     import jax
 
+    from .. import fault
+
     if jax.process_count() == 1 and not _testing_force:
+        fault.guard("collectives.allreduce")
         return value
     q, scale = _int8_quantize(value)
-    return _cross_process_combine((q, scale), _dequant_sum_combine,
-                                  static_args=(value.dtype,))
+    return _combine_with_seam((q, scale), _dequant_sum_combine,
+                              static_args=(value.dtype,))
 
 
 def _dequant_multi_combine(qa, sa, nl, sizes):
@@ -199,14 +232,17 @@ def allreduce_hosts_quantized_multi(values, _testing_force=False):
     import jax
     import jax.numpy as jnp
 
+    from .. import fault
+
     if jax.process_count() == 1 and not _testing_force:
+        fault.guard("collectives.allreduce")
         return list(values)
     qs, scales = zip(*[_int8_quantize(v.ravel()) for v in values])
     sizes = tuple(int(v.size) for v in values)
     flat_q = jnp.concatenate(qs)
-    summed = _cross_process_combine(
-        (flat_q, jnp.stack(scales)), _dequant_multi_combine,
-        static_args=(sizes,))
+    summed = _combine_with_seam((flat_q, jnp.stack(scales)),
+                                _dequant_multi_combine,
+                                static_args=(sizes,))
     out, off = [], 0
     for v, n in zip(values, sizes):
         out.append(summed[off:off + n].reshape(v.shape).astype(v.dtype))

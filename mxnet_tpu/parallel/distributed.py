@@ -59,10 +59,19 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
             "MXNET_WORKER_ID", os.environ.get("DMLC_WORKER_ID", "0")))
     if num_processes <= 1 or coordinator_address is None:
         return False
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id,
-                               local_device_ids=local_device_ids)
+    # preemptible jobs see transient coordinator errors (the scheduler
+    # restarts every process of an SPMD job together, so peers race the
+    # coordinator coming back): retry the rendezvous with bounded backoff
+    # instead of failing the whole restart (MXNET_FAULT_MAX_RETRIES /
+    # MXNET_FAULT_BACKOFF_MS; seam `distributed.init` for chaos tests)
+    from .. import fault
+
+    fault.call_with_retries(
+        "distributed.init", jax.distributed.initialize,
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
     _STATE["initialized"] = True
     return True
 

@@ -136,6 +136,7 @@ def resume():
 def dump(finished=True, profile_process="worker"):
     """Write the Chrome traceEvents file (open in chrome://tracing /
     Perfetto; the XLA-level trace lives in jax_trace/ for TensorBoard)."""
+    from . import fault as _fault
     from .ndarray import dispatch_cache as _dc
 
     dstats = _dc.stats()
@@ -149,7 +150,8 @@ def dump(finished=True, profile_process="worker"):
                        "eager_dispatch_cache": {
                            k: dstats[k] for k in
                            ("enabled", "hits", "misses", "evictions",
-                            "bypasses", "size", "capacity")}}}, f)
+                            "bypasses", "size", "capacity")},
+                       "fault_seams": _fault.stats()}}, f)
     return _CONFIG["filename"]
 
 
@@ -187,6 +189,18 @@ def dumps(reset=False):
         f"evictions={dstats['evictions']} bypasses={dstats['bypasses']} "
         f"size={dstats['size']}/{dstats['capacity']} "
         "(cumulative since reset_dispatch_stats; not scoped to profiling)")
+    # failure-domain counters (mxnet_tpu.fault): which seams saw traffic,
+    # injected/observed trips, and transient-error retries — cumulative
+    # since fault.reset_stats(), like the dispatch-cache counters above
+    from . import fault as _fault
+
+    fstats = _fault.stats()
+    lines.append(f"Fault seams:{'':<20}{'Calls':>12}{'Trips':>10}"
+                 f"{'Retries':>10}")
+    for seam in _fault.SEAMS:
+        c = fstats[seam]
+        lines.append(f"  {seam:<30}{c['calls']:>12}{c['trips']:>10}"
+                     f"{c['retries']:>10}")
     return "\n".join(lines)
 
 

@@ -125,6 +125,225 @@ def test_should_retry_filter(tmp_path):
                           should_retry=lambda e: not isinstance(e, ValueError))
 
 
+def test_meta_records_per_file_checksums(tmp_path):
+    net = _net()
+    net(nd.ones((1, 4)))
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(1, net)
+    files = mgr.read_meta(1)["files"]
+    assert "model.params" in files
+    assert len(files["model.params"]["sha256"]) == 64
+    assert files["model.params"]["size"] > 0
+    assert mgr.verify(1) is None
+
+
+def test_bitflipped_checkpoint_falls_back_to_older_step(tmp_path):
+    """A corrupt newest checkpoint costs one step of progress, not the
+    job (ISSUE 2 acceptance): restore detects the bad sha256 and loads
+    the previous good step without raising."""
+    R = np.random.RandomState(2)
+    X = R.randn(16, 4).astype("f")
+    Y = (X.sum(1) > 0).astype("f")
+    net = _net()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    _step(net, tr, X, Y)
+    mgr.save(1, net, tr)
+    want = net(nd.array(X)).asnumpy()
+    _step(net, tr, X, Y)
+    mgr.save(2, net, tr)
+    # flip one byte of the newest params file
+    p = os.path.join(mgr._step_dir(2), "model.params")
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    assert "sha256 mismatch" in mgr.verify(2)
+
+    net2 = _net()
+    tr2 = gluon.Trainer(net2.collect_params(), "sgd",
+                        {"learning_rate": 0.1})
+    net2(nd.array(X))
+    assert mgr.restore(net2, tr2) == 1
+    np.testing.assert_allclose(net2(nd.array(X)).asnumpy(), want, rtol=1e-6)
+
+
+def test_truncated_checkpoint_falls_back(tmp_path):
+    net = _net()
+    net(nd.ones((1, 4)))
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(1, net)
+    mgr.save(2, net)
+    p = os.path.join(mgr._step_dir(2), "model.params")
+    open(p, "wb").write(open(p, "rb").read()[:10])
+    assert "truncated" in mgr.verify(2)
+    assert mgr.restore(_net()) == 1
+
+
+def test_missing_payload_file_falls_back(tmp_path):
+    net = _net()
+    net(nd.ones((1, 4)))
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(1, net)
+    mgr.save(2, net)
+    os.remove(os.path.join(mgr._step_dir(2), "model.params"))
+    assert "missing" in mgr.verify(2)
+    assert mgr.restore(_net()) == 1
+
+
+def test_unreadable_meta_falls_back(tmp_path):
+    net = _net()
+    net(nd.ones((1, 4)))
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(1, net)
+    mgr.save(2, net)
+    with open(os.path.join(mgr._step_dir(2), "meta.json"), "w") as f:
+        f.write('{"step": 2, "files": {')  # torn json
+    assert mgr.restore(_net()) == 1
+
+
+def test_every_checkpoint_corrupt_returns_zero(tmp_path, caplog):
+    import logging
+
+    net = _net()
+    net(nd.ones((1, 4)))
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    for s in (1, 2):
+        mgr.save(s, net)
+        os.remove(os.path.join(mgr._step_dir(s), "model.params"))
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.checkpoint"):
+        assert mgr.restore(_net()) == 0  # fresh start, with warnings
+    assert sum("failed verification" in m for m in caplog.messages) == 2
+
+
+def test_restore_explicit_missing_step_still_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    with pytest.raises(mx.MXNetError, match="not committed"):
+        mgr.restore(step=7)
+
+
+def test_restore_explicit_corrupt_step_raises_not_falls_back(tmp_path):
+    """An explicitly pinned step must never silently serve different
+    weights: corruption raises instead of falling back."""
+    net = _net()
+    net(nd.ones((1, 4)))
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(1, net)
+    mgr.save(2, net)
+    os.remove(os.path.join(mgr._step_dir(2), "model.params"))
+    with pytest.raises(mx.MXNetError, match="failed verification"):
+        mgr.restore(_net(), step=2)
+    assert mgr.restore(_net(), step=1) == 1  # valid pinned step still loads
+
+
+def test_latest_valid_step_skips_corrupt_newest(tmp_path):
+    """Resume logic (run_with_recovery) must derive the start step from
+    the newest VERIFIED checkpoint, not the raw directory listing."""
+    net = _net()
+    net(nd.ones((1, 4)))
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(1, net)
+    mgr.save(2, net)
+    assert mgr.latest_valid_step() == 2
+    os.remove(os.path.join(mgr._step_dir(2), "model.params"))
+    assert mgr.latest_step() == 2           # raw listing still says 2
+    assert mgr.latest_valid_step() == 1     # but resume must use 1
+
+    # end-to-end: the supervised loop hands train_fn the VERIFIED step
+    starts = []
+
+    def train(start, manager):
+        starts.append(start)
+        return "done"
+
+    run_with_recovery(train, mgr, max_restarts=1, backoff_ms=0)
+    assert starts == [1]
+
+
+def test_load_failed_step_stops_advertising_as_valid(tmp_path):
+    """A pre-checksum checkpoint (no 'files' in meta) with a torn params
+    file passes verify() but fails to load; once restore() has seen that,
+    latest_valid_step() must stop returning it — otherwise the next
+    restart's start step disagrees with the weights actually loaded."""
+    import json
+
+    net = _net()
+    net(nd.ones((1, 4)))
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(1, net)
+    mgr.save(2, net)
+    d2 = mgr._step_dir(2)
+    # simulate a legacy checkpoint: strip checksums, tear the file
+    meta = json.load(open(os.path.join(d2, "meta.json")))
+    del meta["files"]
+    json.dump(meta, open(os.path.join(d2, "meta.json"), "w"))
+    open(os.path.join(d2, "model.params"), "wb").write(b"torn")
+    assert mgr.verify(2) is None            # nothing to checksum
+    assert mgr.latest_valid_step() == 2     # not yet observed failing
+    assert mgr.restore(_net()) == 1         # load fails, falls back
+    assert mgr.latest_valid_step() == 1     # now agrees with restore
+
+
+def test_orphaned_tmp_staging_dirs_swept_on_init(tmp_path):
+    d = tmp_path / "c"
+    mgr = CheckpointManager(str(d))
+    net = _net()
+    net(nd.ones((1, 4)))
+    mgr.save(1, net)
+    # a crash mid-save leaves staging litter behind
+    os.makedirs(str(d / ".tmp_step_2_abc"))
+    open(str(d / ".tmp_step_2_abc" / "model.params"), "w").close()
+    os.makedirs(str(d / ".tmp_step_3_xyz"))
+    mgr2 = CheckpointManager(str(d))
+    names = os.listdir(str(d))
+    assert [n for n in names if n.startswith(".tmp_step_")] == []
+    assert mgr2.latest_step() == 1  # published steps untouched
+
+
+def test_recovery_logs_telemetry_without_logger(tmp_path, caplog):
+    """logger=None must still emit restart telemetry via the module
+    logger — silent restart loops are invisible in production."""
+    import logging
+
+    mgr = CheckpointManager(str(tmp_path / "c"))
+
+    def always_fails(start, manager):
+        raise RuntimeError("boom")
+
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.checkpoint"):
+        with pytest.raises(mx.MXNetError):
+            run_with_recovery(always_fails, mgr, max_restarts=1,
+                              backoff_ms=0)
+    assert any("restart 1/1" in m for m in caplog.messages)
+
+
+def test_restart_budget_resets_on_checkpoint_progress(tmp_path):
+    """A job that keeps advancing its checkpoint survives more failures
+    than max_restarts; a crash loop stuck at one step does not."""
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    attempts = []
+
+    def makes_progress(start, manager):
+        attempts.append(start)
+        if start < 4:
+            manager.save(start + 1)  # one step of progress, then die
+            raise RuntimeError("preempted")
+        return "done"
+
+    # 4 failures total but never 2 consecutive at the same step:
+    # max_restarts=1 still completes
+    assert run_with_recovery(makes_progress, mgr, max_restarts=1,
+                             backoff_ms=0) == "done"
+    assert attempts == [0, 1, 2, 3, 4]
+
+    stuck = CheckpointManager(str(tmp_path / "c2"))
+
+    def no_progress(start, manager):
+        raise RuntimeError("crash loop")
+
+    with pytest.raises(mx.MXNetError, match="without checkpoint progress"):
+        run_with_recovery(no_progress, stuck, max_restarts=2, backoff_ms=0)
+
+
 @pytest.mark.slow
 def test_kill_worker_recovery_resume_parity(tmp_path):
     """A REAL process SIGKILL mid-training, supervised by
