@@ -453,6 +453,38 @@ def main():
                 "skipped": "single device (bus formula is 0 at n=1)"}
     except Exception as e:
         extra["allreduce_bw_64mb"] = {"error": repr(e)[:200]}
+    try:
+        # runtime telemetry (ISSUE 3): attach diagnosis context — cache
+        # efficiency, compile pressure, and the step-phase breakdown — so
+        # BENCH_*.json trajectories explain their throughput, not just
+        # report it
+        import mxnet_tpu as _mx
+        from mxnet_tpu import telemetry as _telemetry
+
+        snap = _telemetry.snapshot()
+        ds = _mx.nd.dispatch_stats()
+        looked = ds["hits"] + ds["misses"]
+        # by_cause from the COUNTER family, not the bounded event ring —
+        # a >512-compile retrace storm would otherwise undercount exactly
+        # when the breakdown matters most
+        by_cause = {}
+        for s in snap["metrics"]["mxnet_compile_events_total"]["samples"]:
+            cause = s["labels"].get("cause", "?")
+            by_cause[cause] = by_cause.get(cause, 0) + int(s["value"])
+        extra["telemetry"] = {
+            "dispatch_cache": {
+                "hit_rate": round(ds["hits"] / looked, 4) if looked else None,
+                "hits": ds["hits"], "misses": ds["misses"],
+                "evictions": ds["evictions"], "bypasses": ds["bypasses"]},
+            "compile": {"count": snap["compile"]["count"],
+                        "total_s": round(snap["compile"]["total_s"], 3),
+                        "by_cause": by_cause},
+            "step_phase_totals_s": {
+                k: round(v, 4)
+                for k, v in snap["step_phase_totals"].items()},
+        }
+    except Exception as e:
+        extra["telemetry"] = {"error": repr(e)[:200]}
 
     out = {
         "metric": "resnet50_train_throughput",

@@ -9,6 +9,9 @@
 #               + kill-worker recovery integration
 #   chaos       fault-injection suite (checkpoint corruption, worker
 #               death, retry exhaustion) + ambient-MXNET_FAULT_SPEC smoke
+#   telemetry   runtime-telemetry smoke (train loop with telemetry +
+#               profiler on; Prometheus/snapshot/compile-event checks)
+#               + the telemetry unit suite
 #   sanity      import + flake-level checks, no heavy tests
 #   nightly     large-tensor + model backwards-compat tier
 #   bench       headline benchmarks (runs on whatever backend is live)
@@ -46,6 +49,16 @@ case "$LANE" in
     #    and is cheap (~20s).  test_checkpoint.py is NOT repeated.
     JAX_PLATFORMS=cpu python -m pytest -q tests/test_fault.py
     ;;
+  telemetry)
+    # 1) end-to-end smoke through the PUBLIC surface (estimator-style
+    #    loop, Trainer(telemetry=True), live HTTP scrape)
+    JAX_PLATFORMS=cpu python ci/telemetry_smoke.py
+    # 2) the unit suite (registry concurrency, bucketing, exporters).
+    #    The unit lane also runs this file; the repeat is deliberate —
+    #    the telemetry stage must stay green/triagable on its own and is
+    #    cheap (~5s)
+    JAX_PLATFORMS=cpu python -m pytest -q tests/test_telemetry.py
+    ;;
   nightly)
     # large-tensor + model backwards-compatibility tier (reference:
     # tests/nightly/ + model_backwards_compatibility_check/); set
@@ -56,7 +69,7 @@ case "$LANE" in
     python bench.py | tee BENCH.json
     ;;
   *)
-    echo "unknown lane: $LANE (unit|tpu|dist|chaos|sanity|nightly|bench)" >&2
+    echo "unknown lane: $LANE (unit|tpu|dist|chaos|telemetry|sanity|nightly|bench)" >&2
     exit 2
     ;;
 esac

@@ -1,0 +1,159 @@
+"""Telemetry-lane smoke (ISSUE 3): a tiny train loop with telemetry +
+profiler on must produce a parseable Prometheus rendering carrying the
+core metric families, a snapshot whose per-step phase durations sum to
+the step wall time, and at least one compile event with a cause.
+
+Run by ci/runtest.sh telemetry as:
+
+    JAX_PLATFORMS=cpu python ci/telemetry_smoke.py
+
+Unlike tests/test_telemetry.py (which exercises the registry through
+pytest fixtures), this drives the PUBLIC end-to-end surface the way an
+operator would — estimator-style loop, Trainer(telemetry=True), HTTP
+endpoint scrape — so a regression in the wiring between layers (not just
+in the registry) fails CI.
+"""
+import json
+import os
+import re
+import sys
+import tempfile
+import urllib.request
+
+# the script lives in ci/; the repo root is the import root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd, profiler, telemetry  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? (-?[0-9.eE+-]+|\+Inf|NaN)$")
+
+CORE_FAMILIES = (
+    "mxnet_dispatch_cache_hits_total",      # dispatch cache
+    "mxnet_dispatch_cache_misses_total",
+    "mxnet_fault_seam_calls_total",         # fault seams
+    "mxnet_step_phase_seconds",             # step timeline
+    "mxnet_step_seconds",
+    "mxnet_compile_events_total",           # compile tracer
+    "mxnet_dataloader_batch_wait_seconds",  # data path
+    "mxnet_kvstore_push_bytes_total",       # kvstore traffic
+)
+
+
+def parse_prometheus(text):
+    """Minimal exposition-format validator: every line is a comment or a
+    `name{labels} value` sample.  Returns the set of sample names."""
+    names = set()
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*",
+                            line), f"bad comment line: {line!r}"
+            continue
+        m = _PROM_LINE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        names.add(line.split("{")[0].split(" ")[0])
+    return names
+
+
+def train_loop(steps=6):
+    net = nn.Dense(2)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01}, telemetry=True)
+    R = np.random.RandomState(0)
+    ds = gluon.data.ArrayDataset(R.randn(steps * 4, 3).astype("f"),
+                                 R.randn(steps * 4, 2).astype("f"))
+    dl = gluon.data.DataLoader(ds, batch_size=4)
+    it = iter(dl)
+    done = 0
+    while True:
+        telemetry.step_begin()
+        with telemetry.phase("data"):
+            batch = next(it, None)
+        if batch is None:
+            telemetry.step_abort()
+            break
+        x, y = batch
+        with telemetry.phase("forward_backward"):
+            with autograd.record():
+                out = net(x)
+                loss = ((out - y) * (out - y)).sum()
+            loss.backward()
+        trainer.step(x.shape[0])
+        telemetry.step_end()
+        done += 1
+    return done
+
+
+def main():
+    telemetry.reset()
+    trace = os.path.join(tempfile.mkdtemp(prefix="telemetry_smoke_"),
+                         "profile.json")
+    profiler.set_config(profile_imperative=True, filename=trace,
+                        jax_trace=False)
+    profiler.start()
+    try:
+        steps = train_loop()
+    finally:
+        profiler.stop()
+    assert steps == 6, steps
+
+    # 1) Prometheus rendering parses; core families present (also via the
+    #    live HTTP endpoint, scraped the way Prometheus would)
+    srv = telemetry.start_http_server(port=0)
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    finally:
+        telemetry.stop_http_server()
+    names = parse_prometheus(body)
+    missing = [f for f in CORE_FAMILIES
+               if not any(n.startswith(f) for n in names)]
+    assert not missing, f"families missing from /metrics: {missing}"
+
+    # 2) snapshot: per-step phase durations sum to ~step wall time
+    snap = telemetry.snapshot()
+    json.dumps(snap)  # must be JSON-serializable end to end
+    assert len(snap["steps"]) == 6, [r["step"] for r in snap["steps"]]
+    for rec in snap["steps"]:
+        total = sum(rec["phases"].values())
+        assert abs(total - rec["wall_s"]) < 1e-6, rec
+        assert {"data", "forward_backward", "optimizer",
+                "collectives"} <= set(rec["phases"]), rec
+
+    # 3) >=1 compile event with a cause (op + hybridized block + anything
+    #    else the loop compiled)
+    evs = snap["compile_events"]
+    assert evs, "no compile events recorded"
+    assert all(e.get("cause") for e in evs), evs
+    kinds = {e["kind"] for e in evs}
+    assert "op" in kinds and "block" in kinds, kinds
+
+    # the step-phase spans made it into the Chrome trace
+    path = profiler.dump()
+    data = json.load(open(path))
+    cats = {e.get("cat") for e in data["traceEvents"]}
+    assert "step_phase" in cats, cats
+    assert "telemetry" in data["otherData"]
+
+    phases = sorted(snap["step_phase_totals"])
+    print(f"telemetry_smoke OK: steps={len(snap['steps'])} "
+          f"phases={phases} compile_events={len(evs)} "
+          f"kinds={sorted(kinds)} prom_families={len(names)}")
+
+
+if __name__ == "__main__":
+    main()

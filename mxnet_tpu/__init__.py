@@ -75,6 +75,7 @@ from . import numpy as np  # mx.np — NumPy-compatible namespace
 from . import numpy_extension as npx
 from . import env
 from . import fault
+from . import telemetry
 
 env.apply_env()
 from . import parallel

@@ -10,15 +10,30 @@ __all__ = ["Speedometer", "do_checkpoint", "log_train_metric", "ProgressBar"]
 
 class Speedometer:
     """Logs samples/sec every `frequent` batches (async-aware: wall-clock
-    between callback invocations, same as the reference)."""
+    between callback invocations, same as the reference).
 
-    def __init__(self, batch_size, frequent=50, auto_reset=True):
+    ``telemetry=True`` additionally publishes the measured speed to the
+    runtime telemetry registry (``mxnet_speedometer_samples_per_sec``
+    gauge + ``mxnet_speedometer_batches_total``) so throughput is
+    scrapeable from a running job, not just greppable from logs."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True,
+                 telemetry=False):
         self.batch_size = batch_size
         self.frequent = frequent
         self.init = False
         self.tic = 0
         self.last_count = 0
         self.auto_reset = auto_reset
+        self.telemetry = telemetry
+
+    def _emit(self, speed):
+        from . import telemetry as _tel
+
+        _tel.gauge("mxnet_speedometer_samples_per_sec",
+                   "throughput over the last Speedometer window").set(speed)
+        _tel.counter("mxnet_speedometer_batches_total",
+                     "batches seen by Speedometer").inc(self.frequent)
 
     def __call__(self, param):
         count = param.nbatch
@@ -28,6 +43,8 @@ class Speedometer:
         if self.init:
             if count % self.frequent == 0:
                 speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                if self.telemetry:
+                    self._emit(speed)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

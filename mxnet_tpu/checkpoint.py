@@ -42,9 +42,21 @@ import tempfile
 import time
 
 from . import fault
+from . import telemetry
 from .base import MXNetError
 
 __all__ = ["CheckpointManager", "run_with_recovery"]
+
+_SAVE_HIST = telemetry.histogram(
+    "mxnet_checkpoint_save_seconds", "checkpoint save duration (publish)")
+_RESTORE_HIST = telemetry.histogram(
+    "mxnet_checkpoint_restore_seconds", "checkpoint restore duration")
+_SAVES_TOTAL = telemetry.counter(
+    "mxnet_checkpoint_saves_total", "published checkpoints")
+_RESTORES_TOTAL = telemetry.counter(
+    "mxnet_checkpoint_restores_total", "completed checkpoint restores")
+_RESTARTS_TOTAL = telemetry.counter(
+    "mxnet_recovery_restarts_total", "run_with_recovery restarts")
 
 _LOGGER = logging.getLogger(__name__)
 
@@ -241,6 +253,10 @@ class CheckpointManager:
 
         primary = jax.process_index() == 0
         final = self._step_dir(step)
+        t0 = time.perf_counter()
+        # a save inside an open telemetry step shows up as its own phase
+        _ph = telemetry.phase("checkpoint")
+        _ph.__enter__()
         try:
             if primary:
                 tmp = tempfile.mkdtemp(prefix=f"{_TMP_PREFIX}{step}_",
@@ -288,8 +304,15 @@ class CheckpointManager:
                 self._gc()
         finally:
             # ALL processes must reach the barrier even when the primary's
-            # write fails — otherwise the peers deadlock in the collective
-            self._barrier()
+            # write fails — otherwise the peers deadlock in the collective;
+            # and the phase must close even when the BARRIER fails, or the
+            # dangling frame mis-attributes the rest of the step
+            try:
+                self._barrier()
+            finally:
+                _ph.__exit__(None, None, None)
+        _SAVE_HIST.observe(time.perf_counter() - t0)
+        _SAVES_TOTAL.inc()
         return final
 
     def restore(self, net=None, trainer=None, step=None, ctx=None):
@@ -304,6 +327,7 @@ class CheckpointManager:
         the strict contract: the caller pinned that checkpoint
         (reproduction run, eval of a named step), so serving different
         weights would be silent corruption — missing or invalid raises."""
+        t0 = time.perf_counter()
         if step is not None:
             if step not in self.all_steps():
                 raise MXNetError(
@@ -314,6 +338,8 @@ class CheckpointManager:
                     f"checkpoint step {step} requested explicitly but "
                     f"failed verification: {problem}")
             self._load(step, net, trainer, ctx)
+            _RESTORE_HIST.observe(time.perf_counter() - t0)
+            _RESTORES_TOTAL.inc()
             return step
         for s in reversed(self.all_steps()):
             if s in self._load_failed:
@@ -339,6 +365,8 @@ class CheckpointManager:
                     "checkpoint step %d failed to load (%r); "
                     "falling back to an older step", s, e)
                 continue
+            _RESTORE_HIST.observe(time.perf_counter() - t0)
+            _RESTORES_TOTAL.inc()
             return s
         return 0
 
@@ -418,6 +446,7 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
                 restarts = 0
             last_failed_step = step_now
             restarts += 1
+            _RESTARTS_TOTAL.inc()
             if restarts > max_restarts:
                 raise MXNetError(
                     f"training failed after {max_restarts} restarts "

@@ -48,6 +48,15 @@ Wired vars (read at ``import mxnet_tpu``):
 - ``MXNET_FAULT_BACKOFF_MS``: first-retry backoff seed in ms (doubles per
   retry, full jitter, 30s cap; default 100).  Also seeds the
   between-restart backoff of ``checkpoint.run_with_recovery``.
+- ``MXNET_TELEMETRY_PORT``: opt-in background HTTP telemetry endpoint
+  (``/metrics`` Prometheus text, ``/snapshot`` JSON, ``/healthz``) on
+  127.0.0.1:<port>, started at import.  Unset/0 = no server (metric
+  RECORDING is always on and costs nothing on the op hot path — see
+  :mod:`mxnet_tpu.telemetry`).
+- ``MXNET_TELEMETRY_TIMELINE_STEPS``: step-timeline ring capacity
+  (completed per-step phase records kept for snapshot(); default 256).
+- ``MXNET_TELEMETRY_COMPILE_EVENTS``: compile-event ring capacity
+  (fresh jax.jit traces kept with elapsed + cause; default 512).
 
 Accepted-but-subsumed (XLA owns the concern; reads return the default and
 ``describe()`` says why):
@@ -146,6 +155,12 @@ def describe():
          "hardened seams (default 3)"),
         ("MXNET_FAULT_BACKOFF_MS", "retry/restart backoff seed in ms "
          "(default 100; doubles per retry, full jitter)"),
+        ("MXNET_TELEMETRY_PORT", "opt-in HTTP telemetry endpoint "
+         "(/metrics Prometheus, /snapshot JSON; unset/0 = off)"),
+        ("MXNET_TELEMETRY_TIMELINE_STEPS", "step-timeline ring capacity "
+         "(default 256; mxnet_tpu.telemetry)"),
+        ("MXNET_TELEMETRY_COMPILE_EVENTS", "compile-event ring capacity "
+         "(default 512; mxnet_tpu.telemetry)"),
     ]
     for name, what in wired:
         lines.append(f"{name}={os.environ.get(name, '<unset>')} — {what}")
@@ -167,3 +182,20 @@ def apply_env():
 
         profiler.set_config(profile_all=True)
         profiler.start()
+    port = get_int("MXNET_TELEMETRY_PORT", 0)
+    if port > 0:
+        from . import telemetry
+
+        try:
+            telemetry.start_http_server(port)
+        except OSError as e:
+            # spawned DataLoader workers and same-host multi-rank peers
+            # inherit the env var but cannot bind the parent's port —
+            # telemetry recording still works, only the endpoint is theirs
+            # to miss; crashing the import would kill the worker pool
+            import warnings
+
+            warnings.warn(
+                f"MXNET_TELEMETRY_PORT={port}: endpoint not started "
+                f"({e}); another process on this host (parent/rank 0?) "
+                "likely holds the port", stacklevel=2)

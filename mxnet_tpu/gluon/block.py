@@ -433,8 +433,16 @@ class HybridBlock(Block):
     def _build_cache(self, key, all_params, args):
         """Trace hybrid_forward once into a jit executable (reference:
         _build_cache / CachedOp construction, SURVEY.md §4.6)."""
+        import time as _time
+
         import jax
 
+        # telemetry compile tracer: a fresh build on a block that already
+        # has cached entries is a retrace (new input signature / train
+        # mode / AMP target) — the thing a retrace storm is made of
+        _compile_t0 = _time.perf_counter()
+        _compile_cause = "new_block" if not self._cached_graph \
+            else "new_signature"
         params_list = all_params
         training = _ag.is_training()
         if not hasattr(self, "_cached_state_params"):
@@ -490,6 +498,12 @@ class HybridBlock(Block):
         self._cached_single[key] = single_box[0]
         entry = (jitted, params_list, n_state)
         self._cached_graph[key] = entry
+        from .. import telemetry as _telemetry
+
+        _telemetry.compile_event(
+            "block", getattr(self, "name", type(self).__name__) or
+            type(self).__name__,
+            _time.perf_counter() - _compile_t0, _compile_cause)
         return entry
 
     def _trace_to_symbol(self, *args):

@@ -5,6 +5,7 @@ import time
 
 from ... import autograd
 from ... import metric as metric_mod
+from ... import telemetry as _telemetry
 from ...base import MXNetError
 
 __all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
@@ -58,7 +59,12 @@ class Estimator:
         self.context = context
 
     def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
-            batch_size=None):
+            batch_size=None, telemetry=False):
+        """``telemetry=True`` opens a telemetry timeline step per batch and
+        attributes it to phases: ``data`` (iterator wait),
+        ``forward_backward``, and ``optimizer`` (``trainer.step`` — which
+        itself splits out ``collectives`` when the Trainer was built with
+        ``telemetry=True``).  See :mod:`mxnet_tpu.telemetry`."""
         if self.trainer is None:
             raise MXNetError("Estimator needs a trainer")
         history = []
@@ -67,17 +73,30 @@ class Estimator:
                 m.reset()
             tic = time.time()
             nsamples = 0
-            for batch in train_data:
+            it = iter(train_data)
+            while True:
+                if telemetry:
+                    _telemetry.step_begin()
+                with _telemetry.maybe_phase(telemetry, "data"):
+                    batch = next(it, None)
+                if batch is None:
+                    if telemetry:
+                        _telemetry.step_abort()
+                    break
                 data, label = batch[0], batch[1]
                 bs = data.shape[0]
-                with autograd.record():
-                    out = self.net(data)
-                    loss = self.loss(out, label)
-                loss.backward()
-                self.trainer.step(bs)
+                with _telemetry.maybe_phase(telemetry, "forward_backward"):
+                    with autograd.record():
+                        out = self.net(data)
+                        loss = self.loss(out, label)
+                    loss.backward()
+                with _telemetry.maybe_phase(telemetry, "optimizer"):
+                    self.trainer.step(bs)
                 nsamples += bs
                 for m in self.train_metrics:
                     m.update([label], [out])
+                if telemetry:
+                    _telemetry.step_end()
             elapsed = time.time() - tic
             stats = {name: val for name, val in
                      (m.get() for m in self.train_metrics)}

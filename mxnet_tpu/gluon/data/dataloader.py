@@ -17,14 +17,28 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as _np
 
+from ... import telemetry
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
 
 __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+_BATCH_WAIT = telemetry.histogram(
+    "mxnet_dataloader_batch_wait_seconds",
+    "time the consumer waited for the next batch")
+_BATCHES_TOTAL = telemetry.counter(
+    "mxnet_dataloader_batches_total", "batches yielded")
+_WORKERS_GAUGE = telemetry.gauge(
+    "mxnet_dataloader_workers",
+    "live process-pool workers (of the most recently active loader)")
+_WORKER_DEATHS = telemetry.counter(
+    "mxnet_dataloader_worker_deaths_total",
+    "abnormal process-worker deaths detected mid-epoch")
 
 
 def default_batchify_fn(data):
@@ -154,6 +168,22 @@ class DataLoader:
         return len(self._batch_sampler)
 
     def __iter__(self):
+        # batch-wait attribution: time from the consumer asking for the
+        # next batch to it being ready — with a prefetching pool this is
+        # the stall the training loop actually feels, the "data wait"
+        # answer to "why was this step slow?"
+        it = self._iter_impl()
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            _BATCH_WAIT.observe(_time.perf_counter() - t0)
+            _BATCHES_TOTAL.inc()
+            yield batch
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for batch in self._batch_sampler:
                 yield self._batchify_fn([self._dataset[i] for i in batch])
@@ -231,7 +261,9 @@ class DataLoader:
                     break
                 except mp.TimeoutError:
                     dead = [p for p in workers if p.exitcode is not None]
+                    _WORKERS_GAUGE.set(len(workers) - len(dead))
                     if dead:
+                        _WORKER_DEATHS.inc(len(dead))
                         # the pool's task bookkeeping is now unknowable
                         # (the dead child's in-flight batch is lost);
                         # discard it so the NEXT epoch gets clean workers.
@@ -287,6 +319,7 @@ class DataLoader:
                 os.environ["JAX_PLATFORMS"] = prev
         self._proc_pool = pool
         self._proc_pool_method = method
+        _WORKERS_GAUGE.set(self._num_workers)
         # terminate workers when the loader is garbage collected (or at
         # interpreter exit) — __del__ alone is not reliable enough for
         # child processes.  The finalizer carries the stop-event set (no
@@ -303,7 +336,9 @@ class DataLoader:
         if self._pool_finalizer is not None:
             self._pool_finalizer()  # terminates + joins, idempotent
             self._pool_finalizer = None
-        self._proc_pool = None
+        if self._proc_pool is not None:
+            _WORKERS_GAUGE.set(0)   # a scrape after close() must not
+        self._proc_pool = None      # report the dead pool as live
         self._proc_pool_method = None
 
     def _abandon_proc_pool(self):
@@ -322,6 +357,7 @@ class DataLoader:
             self._pool_finalizer = None
         self._proc_pool = None
         self._proc_pool_method = None
+        _WORKERS_GAUGE.set(0)
         for p in list(pool._pool):
             try:
                 p.kill()

@@ -15,14 +15,24 @@ from __future__ import annotations
 from ..base import MXNetError
 from .. import optimizer as opt_mod
 from .. import kvstore as kvs_mod
+from .. import telemetry as _telemetry
 from .parameter import ParameterDict, Parameter
 
 __all__ = ["Trainer"]
 
+_STEPS_TOTAL = _telemetry.counter(
+    "mxnet_trainer_steps_total", "Trainer.step calls (telemetry=True)")
+
 
 class Trainer:
+    """``telemetry=True`` attributes each ``step()`` to the telemetry step
+    timeline: gradient sync as the ``collectives`` phase, the parameter
+    update as ``optimizer`` (see :mod:`mxnet_tpu.telemetry`).  Off by
+    default — the hot path gains nothing when disabled."""
+
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 telemetry=False):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -44,6 +54,7 @@ class Trainer:
         self._kv_initialized = False
         self._kvstore = None
         self._update_on_kvstore = None
+        self._telemetry = bool(telemetry)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -106,8 +117,12 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         if self._kvstore is not None and self._kvstore._optimizer is not None:
             self._kvstore._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        if self._telemetry:
+            _STEPS_TOTAL.inc()
+        with _telemetry.maybe_phase(self._telemetry, "collectives"):
+            self._allreduce_grads()
+        with _telemetry.maybe_phase(self._telemetry, "optimizer"):
+            self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -31,11 +31,31 @@ import pickle
 import numpy as _np
 
 from . import fault
+from . import telemetry
 from .base import MXNetError, Registry
 from .ndarray.ndarray import NDArray, invoke
 from .ndarray import ndarray as _ndm
 
 __all__ = ["KVStore", "create"]
+
+_PUSH_BYTES = telemetry.counter(
+    "mxnet_kvstore_push_bytes_total", "bytes pushed (post-reduce, per key)")
+_PULL_BYTES = telemetry.counter(
+    "mxnet_kvstore_pull_bytes_total", "bytes pulled (per output)")
+_PUSH_OPS = telemetry.counter("mxnet_kvstore_push_ops_total", "push calls")
+_PULL_OPS = telemetry.counter("mxnet_kvstore_pull_ops_total", "pull calls")
+
+
+def _nd_nbytes(v):
+    """Best-effort payload size of an NDArray/RowSparse value — shape and
+    dtype reads never sync the device."""
+    try:
+        data = getattr(v, "data", None)   # RowSparseNDArray: count rows
+        if data is not None and isinstance(data, NDArray):
+            v = data
+        return int(v.size) * _np.dtype(v.dtype).itemsize
+    except Exception:
+        return 0
 
 
 def _as_list(x):
@@ -118,10 +138,12 @@ class KVStore:
         from .ndarray.sparse import RowSparseNDArray
 
         fault.guard("kvstore.push")
+        _PUSH_OPS.inc()
 
         keys, grouped = _group_key_value(key, value)
         for k, vals in zip(keys, grouped):
             reduced = _reduce(vals)
+            _PUSH_BYTES.inc(_nd_nbytes(reduced))
             if not isinstance(reduced, RowSparseNDArray):
                 self._dense_pushed.add(k)
             if (isinstance(reduced, RowSparseNDArray)
@@ -168,6 +190,7 @@ class KVStore:
         """Broadcast stored value to every output (≙ CommDevice::Broadcast).
         Entry guard: see ``push`` — same retry-before-mutation contract."""
         fault.guard("kvstore.pull")
+        _PULL_OPS.inc()
         keys, grouped = _group_key_value(key, out)
         for k, outs in zip(keys, grouped):
             if k not in self._store:
@@ -177,6 +200,7 @@ class KVStore:
                 src = self._materialize(k)
             for o in outs:
                 o._set(src.as_in_context(o.context)._get().astype(o._get().dtype))
+                _PULL_BYTES.inc(_nd_nbytes(o))
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
@@ -577,8 +601,11 @@ class DistTPUSyncKVStore(KVStore):
         from .ndarray.sparse import RowSparseNDArray
 
         fault.guard("kvstore.push")
+        _PUSH_OPS.inc()
         keys, grouped = _group_key_value(key, value)
         reduced_list = [_reduce(vals) for vals in grouped]
+        for reduced in reduced_list:
+            _PUSH_BYTES.inc(_nd_nbytes(reduced))
         # record dense traffic like the base store does: the inherited
         # row_sparse_pull promote gate reads _dense_pushed, and a key it
         # wrongly promotes would crash this push path (no host-table

@@ -42,10 +42,20 @@ __all__ = ["enabled", "set_enabled", "set_capacity", "capacity", "lookup",
 _LOCK = threading.Lock()
 _CACHE = OrderedDict()          # key -> jitted callable (LRU: last = newest)
 _BLOCKLIST = set()              # opnames with >=1 trace failure (reporting)
-_FAIL_COUNTS = {}               # opname -> distinct-key trace failures
+_FAILED_KEYS = {}               # opname -> set of DISTINCT failing keys
+_FAIL_COUNTS = {}               # opname -> keyless trace failures (legacy)
 _OP_BLOCK_AFTER = 3             # stop re-trying jit for an op past this
 _STATS = {"hits": 0, "misses": 0, "evictions": 0, "bypasses": 0}
 _PER_OP = {}                    # opname -> [hits, misses, bypasses]
+# compile-cause tracking: per op, the attrs-keys / shapes / dtypes / mode
+# tokens already compiled — a fresh compile's cause is the first component
+# that is new (telemetry compile-event tracer).  Each per-op set is capped
+# (a variable-shape retrace storm — the exact workload the tracer exists
+# to diagnose — must not leak memory proportional to distinct shapes):
+# past the cap new tokens still classify correctly, they are just not
+# remembered, so a later repeat re-reports its new_* cause.
+_COMPILE_SEEN = {}
+_COMPILE_SEEN_CAP = 4096
 
 _CFG = {
     "on": _env.get_bool("MXNET_EAGER_JIT", True),
@@ -170,18 +180,27 @@ def is_blocked(opname):
     """True once an op has failed to trace on several DISTINCT keys —
     attrs-specific failures keep the fast path for the op's other
     variants (their failing keys get an eager entry instead)."""
-    return _FAIL_COUNTS.get(opname, 0) >= _OP_BLOCK_AFTER
+    return (len(_FAILED_KEYS.get(opname, ())) +
+            _FAIL_COUNTS.get(opname, 0)) >= _OP_BLOCK_AFTER
 
 
-def mark_unsafe(opname):
+def mark_unsafe(opname, key=None):
     """Record a trace failure for ``opname`` and warn once per op.  The
     failing (op, attrs, avals) key itself gets the eager fn cached in its
-    LRU slot by the caller, so only repeated failures on NEW keys escalate
-    to blocking the whole op."""
+    LRU slot by the caller, so only failures on DISTINCT keys escalate to
+    blocking the whole op: ``key`` identifies the failing variant, and
+    re-failures of an already-recorded key (its eager entry was LRU-
+    evicted and the retrace failed again) do not count toward the block
+    threshold (ROADMAP open item: eviction-driven re-failures of one
+    variant must not falsely blocklist a whole op).  Callers without a
+    key (legacy/tests) fall back to a per-op counter."""
     with _LOCK:
         fresh = opname not in _BLOCKLIST
         _BLOCKLIST.add(opname)
-        _FAIL_COUNTS[opname] = _FAIL_COUNTS.get(opname, 0) + 1
+        if key is None:
+            _FAIL_COUNTS[opname] = _FAIL_COUNTS.get(opname, 0) + 1
+        else:
+            _FAILED_KEYS.setdefault(opname, set()).add(key)
     if fresh:
         import warnings
 
@@ -189,6 +208,47 @@ def mark_unsafe(opname):
             f"mxnet_tpu: op {opname!r} failed to jit-compile and runs "
             "eagerly (see mx.nd.dispatch_stats()['blocklisted'])",
             stacklevel=3)
+
+
+def record_compile(opname, key, elapsed_s, failed=False):
+    """Telemetry hook for a fresh compile on the invoke seam.  ``key`` is
+    the full cache key; the cause is derived from which component of it is
+    new for this op (shape/dtype/attrs/mode), so retrace storms name their
+    driver.  Called only on the miss path — hits never reach here."""
+    if failed:
+        cause = "trace_failure"
+    else:
+        shapes = tuple(a[0] for a in key[2])
+        dtypes = tuple(str(a[1]) for a in key[2])
+        mode = key[3:]
+        with _LOCK:
+            seen = _COMPILE_SEEN.get(opname)
+            if seen is None:
+                _COMPILE_SEEN[opname] = {"akeys": {key[1]},
+                                         "shapes": {shapes},
+                                         "dtypes": {dtypes},
+                                         "modes": {mode}}
+                cause = "new_op"
+            else:
+                if dtypes not in seen["dtypes"]:
+                    cause = "new_dtype"
+                elif shapes not in seen["shapes"]:
+                    cause = "new_shape"
+                elif key[1] not in seen["akeys"]:
+                    cause = "new_attrs"
+                elif mode not in seen["modes"]:
+                    cause = "mode_change"   # AMP epoch / ctx / train flip
+                else:
+                    cause = "recompile"     # LRU-evicted entry re-traced
+                for s, token in ((seen["akeys"], key[1]),
+                                 (seen["shapes"], shapes),
+                                 (seen["dtypes"], dtypes),
+                                 (seen["modes"], mode)):
+                    if len(s) < _COMPILE_SEEN_CAP:
+                        s.add(token)
+    from .. import telemetry
+
+    telemetry.compile_event("op", opname, elapsed_s, cause)
 
 
 def _per_op(opname):
@@ -241,6 +301,10 @@ def stats():
             "evictions": _STATS["evictions"],
             "bypasses": _STATS["bypasses"],
             "blocklisted": sorted(_BLOCKLIST),
+            "trace_failures": {
+                name: len(_FAILED_KEYS.get(name, ()))
+                + _FAIL_COUNTS.get(name, 0)
+                for name in sorted(set(_FAILED_KEYS) | set(_FAIL_COUNTS))},
             "per_op": {name: {"hits": c[0], "misses": c[1], "bypasses": c[2]}
                        for name, c in sorted(_PER_OP.items())},
         }
@@ -251,6 +315,7 @@ def reset_stats():
         for k in _STATS:
             _STATS[k] = 0
         _PER_OP.clear()
+        _COMPILE_SEEN.clear()
 
 
 def clear():

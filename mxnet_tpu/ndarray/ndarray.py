@@ -21,6 +21,7 @@ invariant, §4.1):
 from __future__ import annotations
 
 import functools
+import time as _time
 import weakref
 
 import numpy as _np
@@ -705,9 +706,12 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
     # thread's profiler.stop() must not null it mid-op.
     _prof_rec = _PROFILE["record"] if _PROFILE["on"] else None
     if _prof_rec is not None:
-        import time as _time
-
         _prof_t0 = _time.perf_counter()
+
+    # fresh compile about to happen (miss path only — hits never get here
+    # with fn set): time it for the telemetry compile-event tracer
+    _compile_t0 = _time.perf_counter() \
+        if (fn is not None and cache_key is not None) else None
 
     try:
         if recording:
@@ -740,9 +744,12 @@ def invoke(opname, nd_args, attrs, out=None, ctx=None):
             out_vals = fn(*in_vals)
             multi = isinstance(out_vals, (tuple, list))
             out_entries = None
-        _dc.mark_unsafe(od.name)
+        _dc.mark_unsafe(od.name, cache_key)
     if fn is not None and cache_key is not None:
         _dc.insert(cache_key, call_fn)
+        _dc.record_compile(od.name, cache_key,
+                           _time.perf_counter() - _compile_t0,
+                           failed=call_fn is fn)
 
     if _prof_rec is not None:
         _sync = out_vals[0] if multi else out_vals

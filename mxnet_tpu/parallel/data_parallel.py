@@ -341,6 +341,7 @@ class TrainStep:
         donate_argnums = (0, 1, 2) if donate else ()
         self._step = jax.jit(step, donate_argnums=donate_argnums)
         self._rng_seed = 0
+        self._seen_sigs = set()  # telemetry: (x, y) avals already compiled
 
     @property
     def params(self):
@@ -360,8 +361,28 @@ class TrainStep:
             y = jax.device_put(_np.asarray(y), self._batch_shard)
         rng = jr.PRNGKey(self._rng_seed)
         self._rng_seed += 1
+        # telemetry compile tracer: an unseen batch signature means this
+        # call traces+compiles the whole step before running it.  The set
+        # is capped like dispatch_cache._COMPILE_SEEN — a variable-shape
+        # workload must not leak memory proportional to distinct sigs
+        # (past the cap fresh compiles simply go unrecorded)
+        sig = (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "")),
+               tuple(getattr(y, "shape", ())), str(getattr(y, "dtype", "")))
+        fresh = sig not in self._seen_sigs and len(self._seen_sigs) < 4096
+        if fresh:
+            import time as _t
+
+            self._seen_sigs.add(sig)
+            t0 = _t.perf_counter()
         loss, self.train_params, self.rest_params, self.opt_state = self._step(
             self.train_params, self.rest_params, self.opt_state, rng, x, y)
+        if fresh:
+            from .. import telemetry as _telemetry
+
+            _telemetry.compile_event(
+                "train_step", type(self._net).__name__,
+                _t.perf_counter() - t0,
+                "new_step" if len(self._seen_sigs) == 1 else "new_shape")
         return loss
 
     def write_back(self):

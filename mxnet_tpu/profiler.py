@@ -27,23 +27,28 @@ __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
            "Task", "Frame", "Marker", "Counter", "Domain", "Scope"]
 
 _CONFIG = {"filename": "profile.json", "profile_all": False,
-           "profile_imperative": False, "dir": None, "jax_trace": True}
+           "profile_imperative": False, "dir": None, "jax_trace": True,
+           "continuous_dump": False}
 _ACTIVE = False
 _PAUSED = False
 _LOCK = threading.Lock()
 _EVENTS = []   # chrome trace events
 _AGG = {}      # opname -> [count, total_s, min_s, max_s]
 _T0 = None
+_DUMPED_ONCE = False  # continuous_dump: later dumps merge into the file
 
 
 def set_config(profile_all=False, profile_symbolic=False,
                profile_imperative=False, profile_memory=False,
                profile_api=False, filename="profile.json",
                continuous_dump=False, jax_trace=True, **kwargs):
+    global _DUMPED_ONCE
     _CONFIG.update(profile_all=profile_all, filename=filename,
                    profile_imperative=profile_imperative or profile_all,
-                   jax_trace=jax_trace)
+                   jax_trace=jax_trace,
+                   continuous_dump=bool(continuous_dump))
     _CONFIG["dir"] = os.path.dirname(os.path.abspath(filename)) or "."
+    _DUMPED_ONCE = False
 
 
 def _record_op(opname, t0, t1):
@@ -72,6 +77,18 @@ def _instant(name, cat):
                         "ts": (time.perf_counter() - _T0) * 1e6, "cat": cat})
 
 
+def _record_span(name, t0, t1, cat="step_phase", tid=1000):
+    """Telemetry hook: merge a step-phase / compile span into the Chrome
+    trace (its own tid row so phases don't interleave with op events).
+    ``t0``/``t1`` are perf_counter values — the same clock as ``_T0``."""
+    if _T0 is None or not _ACTIVE or _PAUSED:
+        return
+    with _LOCK:
+        _EVENTS.append({"name": name, "ph": "X", "pid": 0, "tid": tid,
+                        "ts": (t0 - _T0) * 1e6, "dur": (t1 - t0) * 1e6,
+                        "cat": cat})
+
+
 def _counter(name, value):
     if _T0 is None or not _ACTIVE or _PAUSED:
         return
@@ -82,11 +99,12 @@ def _counter(name, value):
 
 
 def start():
-    global _ACTIVE, _T0, _PAUSED
+    global _ACTIVE, _T0, _PAUSED, _DUMPED_ONCE
     from .ndarray.ndarray import _PROFILE
 
     _T0 = time.perf_counter()
     _PAUSED = False
+    _DUMPED_ONCE = False  # a new session never merges into an old file
     if _CONFIG.get("jax_trace", True):
         import jax
 
@@ -133,15 +151,41 @@ def resume():
         _PROFILE["on"] = True
 
 
-def dump(finished=True, profile_process="worker"):
+def dump(finished=True, profile_process="worker", drain=None):
     """Write the Chrome traceEvents file (open in chrome://tracing /
-    Perfetto; the XLA-level trace lives in jax_trace/ for TensorBoard)."""
+    Perfetto; the XLA-level trace lives in jax_trace/ for TensorBoard).
+
+    ``drain=True`` removes the written events from the in-memory buffer
+    so a later dump never re-emits them.  Under
+    ``set_config(continuous_dump=True)`` draining is implied (the file IS
+    the buffer then — ``drain=False`` is ignored) and successive
+    ``dump()`` calls MERGE the drained increments into the existing trace
+    file, so periodic dumping from a long-running job yields one growing,
+    duplicate-free trace."""
+    global _DUMPED_ONCE
     from . import fault as _fault
+    from . import telemetry as _telemetry
     from .ndarray import dispatch_cache as _dc
 
+    if _CONFIG["continuous_dump"]:
+        # the merge base is "everything drained so far"; leaving events
+        # undrained while merging would re-emit them on the next dump
+        drain = True
+    elif drain is None:
+        drain = False
     dstats = _dc.stats()
     with _LOCK:
         events = list(_EVENTS)
+        if drain:
+            _EVENTS.clear()
+    if _CONFIG["continuous_dump"] and _DUMPED_ONCE and \
+            os.path.exists(_CONFIG["filename"]):
+        try:
+            with open(_CONFIG["filename"]) as f:
+                prior = json.load(f).get("traceEvents", [])
+            events = prior + events
+        except (OSError, ValueError):
+            pass  # unreadable prior dump: write this increment standalone
     with open(_CONFIG["filename"], "w") as f:
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms",
@@ -151,7 +195,9 @@ def dump(finished=True, profile_process="worker"):
                            k: dstats[k] for k in
                            ("enabled", "hits", "misses", "evictions",
                             "bypasses", "size", "capacity")},
-                       "fault_seams": _fault.stats()}}, f)
+                       "fault_seams": _fault.stats(),
+                       "telemetry": _telemetry.snapshot()}}, f)
+    _DUMPED_ONCE = True
     return _CONFIG["filename"]
 
 

@@ -1,0 +1,747 @@
+"""Unified runtime telemetry: metrics registry, step timeline, compile
+tracing, and Prometheus/JSON exporters.
+
+The reference stack's only visibility was a Chrome-trace profiler plus
+ad-hoc counters (``dispatch_cache.stats()``, ``fault.stats()``).  This
+module is the cross-cutting layer that makes a running job diagnosable:
+
+- **Metrics registry**: process-wide, thread-safe Counter / Gauge /
+  Histogram families with labels and exponential buckets.  Recording is
+  always-on and cheap (one lock + dict update); nothing here sits on the
+  per-op eager hot path — the dispatch cache and fault seams keep their
+  own lock-striped counters and are *scraped* through collectors at
+  export time instead of double-counting per call.
+- **Step timeline**: ``step_begin()`` / ``phase(name)`` / ``step_end()``
+  attribute each training step to phases (``data``, ``forward_backward``,
+  ``optimizer``, ``collectives``, ``checkpoint``, ``other``).  Phases
+  nest with *innermost-wins* attribution — the outer phase's clock pauses
+  while an inner phase runs — so per-step phase durations always sum to
+  the step's wall time.  Completed steps land in a bounded ring
+  (``MXNET_TELEMETRY_TIMELINE_STEPS``, default 256) and, when the
+  profiler is active, as ``step_phase`` spans in the Chrome trace.
+- **Compile-event tracer**: every fresh ``jax.jit`` trace — a registry op
+  (dispatch_cache miss), a hybridized block build, or a TrainStep — is
+  recorded with its elapsed time and a *cause* (``new_op`` /
+  ``new_shape`` / ``new_dtype`` / ``new_attrs`` / ``mode_change`` /
+  ``recompile`` / ``trace_failure``), so retrace storms are diagnosable
+  from the event stream instead of guessed from step-time jitter.
+- **Exporters**: ``render_prometheus()`` (text exposition),
+  ``snapshot()`` (JSON; also embedded in ``profiler.dump()`` otherData
+  and ``bench.py`` extras), and an opt-in background HTTP endpoint
+  (``MXNET_TELEMETRY_PORT`` or ``start_http_server(port)``) serving
+  ``/metrics``, ``/snapshot``, and ``/healthz``.
+
+Metric catalog (see README "Observability" for the full table): step
+phases (``mxnet_step_phase_seconds``), compile events
+(``mxnet_compile_events_total{kind,cause}``), dispatch cache
+(``mxnet_dispatch_cache_*`` via collector), fault seams
+(``mxnet_fault_seam_*_total{seam}`` via collector), DataLoader
+(``mxnet_dataloader_batch_wait_seconds``, worker liveness), kvstore
+traffic (``mxnet_kvstore_{push,pull}_bytes_total``), checkpoint
+durations, and ``mxnet_recovery_restarts_total``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+from . import env as _env
+
+__all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
+           "exponential_buckets", "register_collector", "snapshot",
+           "render_prometheus", "start_http_server", "stop_http_server",
+           "step_begin", "step_end", "step_abort", "step_scope", "phase",
+           "maybe_phase", "timeline", "compile_event", "compile_events",
+           "reset"]
+
+_LOCK = threading.RLock()
+_FAMILIES: dict = {}        # name -> _Family
+_COLLECTORS: list = []      # zero-arg callables -> [family dict, ...]
+
+# default duration buckets: 100µs .. ~13s, exponential
+_TIME_BUCKETS = None  # filled after exponential_buckets is defined
+
+
+def exponential_buckets(start, factor, count):
+    """``count`` bucket upper bounds growing geometrically from ``start``
+    (Prometheus-style; +Inf is implicit)."""
+    out = []
+    b = float(start)
+    for _ in range(count):
+        out.append(b)
+        b *= factor
+    return out
+
+
+_TIME_BUCKETS = exponential_buckets(1e-4, 2.0, 18)
+
+
+# --------------------------------------------------------------------------
+# metric primitives
+# --------------------------------------------------------------------------
+class _Child:
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        self._value = 0.0
+
+
+class Counter(_Child):
+    """Monotonic counter (family child)."""
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with _LOCK:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge(_Child):
+    """Settable value (family child)."""
+
+    def set(self, value):
+        with _LOCK:
+            self._value = float(value)
+
+    def inc(self, amount=1.0):
+        with _LOCK:
+            self._value += amount
+
+    def dec(self, amount=1.0):
+        with _LOCK:
+            self._value -= amount
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Histogram with cumulative-at-export buckets (family child)."""
+
+    __slots__ = ("_buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets=None):
+        bs = sorted(float(b) for b in (buckets or _TIME_BUCKETS))
+        self._buckets = bs
+        self._counts = [0] * len(bs)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value):
+        v = float(value)
+        with _LOCK:
+            self._sum += v
+            self._count += 1
+            for i, b in enumerate(self._buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    break
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def cumulative(self):
+        """[(upper_bound, cumulative_count), ...] ending with +Inf."""
+        out = []
+        acc = 0
+        with _LOCK:
+            for b, c in zip(self._buckets, self._counts):
+                acc += c
+                out.append((b, acc))
+            out.append((float("inf"), self._count))
+        return out
+
+
+class _Family:
+    """A named metric family with fixed label names; children per label
+    value tuple.  Unlabeled families proxy their single ``()`` child."""
+
+    def __init__(self, name, help, mtype, labelnames=(), buckets=None):
+        self.name = name
+        self.help = help
+        self.type = mtype
+        self.labelnames = tuple(labelnames)
+        self._buckets = buckets
+        self._children: dict = {}
+        if not self.labelnames:
+            self._children[()] = self._new_child()
+
+    def _new_child(self):
+        if self.type == "counter":
+            return Counter()
+        if self.type == "gauge":
+            return Gauge()
+        return Histogram(self._buckets)
+
+    def labels(self, *values, **kw):
+        if kw:
+            if values:
+                raise ValueError("pass labels positionally or by name")
+            values = tuple(str(kw[n]) for n in self.labelnames)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {values}")
+        with _LOCK:
+            child = self._children.get(values)
+            if child is None:
+                child = self._children[values] = self._new_child()
+            return child
+
+    # unlabeled convenience proxies
+    def inc(self, amount=1.0):
+        self._children[()].inc(amount)
+
+    def set(self, value):
+        self._children[()].set(value)
+
+    def dec(self, amount=1.0):
+        self._children[()].dec(amount)
+
+    def observe(self, value):
+        self._children[()].observe(value)
+
+    @property
+    def value(self):
+        return self._children[()].value
+
+    @property
+    def count(self):
+        return self._children[()].count
+
+    @property
+    def sum(self):
+        return self._children[()].sum
+
+    def cumulative(self):
+        return self._children[()].cumulative()
+
+    def children(self):
+        with _LOCK:
+            return list(self._children.items())
+
+
+def _get_or_create(name, help, mtype, labelnames=(), buckets=None):
+    with _LOCK:
+        fam = _FAMILIES.get(name)
+        if fam is not None:
+            if fam.type != mtype or fam.labelnames != tuple(labelnames):
+                raise ValueError(
+                    f"metric {name!r} re-registered with a different "
+                    f"type/labels ({fam.type}{fam.labelnames} vs "
+                    f"{mtype}{tuple(labelnames)})")
+            return fam
+        fam = _Family(name, help, mtype, labelnames, buckets)
+        _FAMILIES[name] = fam
+        return fam
+
+
+def counter(name, help="", labelnames=()):
+    """Get-or-create a Counter family."""
+    return _get_or_create(name, help, "counter", labelnames)
+
+
+def gauge(name, help="", labelnames=()):
+    """Get-or-create a Gauge family."""
+    return _get_or_create(name, help, "gauge", labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=None):
+    """Get-or-create a Histogram family (default: exponential duration
+    buckets 100µs..13s)."""
+    return _get_or_create(name, help, "histogram", labelnames, buckets)
+
+
+def register_collector(fn):
+    """Register a zero-arg callable run at export time returning a list of
+    ``{"name", "type", "help", "samples": [(labels_dict, value), ...]}``
+    dicts — the scrape-time bridge for subsystems that keep their own
+    counters (dispatch cache, fault seams) so their hot paths never pay a
+    second lock."""
+    with _LOCK:
+        _COLLECTORS.append(fn)
+    return fn
+
+
+# --------------------------------------------------------------------------
+# step timeline
+# --------------------------------------------------------------------------
+_TIMELINE_CAP = max(1, _env.get_int("MXNET_TELEMETRY_TIMELINE_STEPS", 256))
+_STEPS: deque = deque(maxlen=_TIMELINE_CAP)
+_CUR = None          # active step: {"step", "t0", "wall0", "phases", "stack"}
+_STEP_SEQ = [0]
+
+_PHASE_HIST = histogram(
+    "mxnet_step_phase_seconds",
+    "per-step time attributed to each phase (exclusive of nested phases)",
+    labelnames=("phase",))
+_STEP_HIST = histogram("mxnet_step_seconds", "training step wall time")
+_STEPS_TOTAL = counter("mxnet_steps_total", "completed timeline steps")
+
+
+def _chrome_span(name, t0, t1, cat):
+    try:
+        from . import profiler as _prof
+
+        _prof._record_span(name, t0, t1, cat)
+    except Exception:
+        pass
+
+
+def step_begin(step=None):
+    """Open a timeline step.  An unfinished previous step is finalized
+    first (robustness beats strictness in a training loop)."""
+    global _CUR
+    with _LOCK:
+        if _CUR is not None:
+            _finalize_locked(time.perf_counter())
+        if step is None:
+            step = _STEP_SEQ[0]
+        step = int(step)
+        _STEP_SEQ[0] = step + 1
+        _CUR = {"step": step, "t0": time.perf_counter(),
+                "wall0": time.time(), "phases": {}, "stack": []}
+    # return the local, not _CUR["step"]: a concurrent step_end/abort may
+    # have nulled _CUR the instant the lock dropped
+    return step
+
+
+def _finalize_locked(now):
+    """Complete the active step (lock held).  Returns the record."""
+    global _CUR
+    cur = _CUR
+    _CUR = None
+    if cur is None:
+        return None
+    stack = cur["stack"]
+    if stack:
+        # only the innermost frame has unclaimed elapsed time: every outer
+        # frame was charged (and left paused) when its inner frame entered
+        name, t = stack[-1]
+        cur["phases"][name] = cur["phases"].get(name, 0.0) + (now - t)
+        del stack[:]
+    wall = now - cur["t0"]
+    phases = cur["phases"]
+    other = wall - sum(phases.values())
+    if other > 1e-9:
+        phases["other"] = other
+    rec = {"step": cur["step"], "time": cur["wall0"],
+           "wall_s": wall, "phases": dict(phases)}
+    _STEPS.append(rec)
+    for pname, dt in phases.items():
+        _PHASE_HIST.labels(phase=pname).observe(dt)
+    _STEP_HIST.observe(wall)
+    _STEPS_TOTAL.inc()
+    _chrome_span(f"step {cur['step']}", cur["t0"], now, "step")
+    return rec
+
+
+def step_end():
+    """Close the active step; returns its record (phase durations sum to
+    the step wall time — unattributed time lands in ``other``)."""
+    with _LOCK:
+        return _finalize_locked(time.perf_counter())
+
+
+def step_abort():
+    """Discard the active step without recording (e.g. the data phase hit
+    StopIteration — there is no step)."""
+    global _CUR
+    with _LOCK:
+        _CUR = None
+
+
+class _PhaseScope:
+    __slots__ = ("name", "_t0")
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        now = time.perf_counter()
+        self._t0 = now
+        with _LOCK:
+            cur = _CUR
+            if cur is not None:
+                stack = cur["stack"]
+                if stack:
+                    # pause the outer phase: charge it up to now
+                    oname, ot = stack[-1]
+                    cur["phases"][oname] = \
+                        cur["phases"].get(oname, 0.0) + (now - ot)
+                    stack[-1][1] = now
+                stack.append([self.name, now])
+        return self
+
+    def __exit__(self, *exc):
+        now = time.perf_counter()
+        with _LOCK:
+            cur = _CUR
+            if cur is not None and cur["stack"] \
+                    and cur["stack"][-1][0] == self.name:
+                _, t = cur["stack"].pop()
+                cur["phases"][self.name] = \
+                    cur["phases"].get(self.name, 0.0) + (now - t)
+                if cur["stack"]:
+                    cur["stack"][-1][1] = now  # outer phase resumes
+            elif cur is None:
+                # phase outside a step: still observable in the histogram
+                _PHASE_HIST.labels(phase=self.name).observe(now - self._t0)
+        _chrome_span(f"phase:{self.name}", self._t0, now, "step_phase")
+        return False
+
+
+def phase(name):
+    """Context manager attributing its (exclusive) duration to ``name`` in
+    the active step; outside a step it records straight to the phase
+    histogram."""
+    return _PhaseScope(name)
+
+
+class _NullScope:
+    """Reusable no-op context for call sites with an opt-in telemetry flag
+    (Trainer/Estimator): the disabled path pays one attribute read."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SCOPE = _NullScope()
+
+
+def maybe_phase(enabled, name):
+    """``phase(name)`` when ``enabled``, else a shared no-op scope."""
+    return _PhaseScope(name) if enabled else _NULL_SCOPE
+
+
+class _StepScope:
+    def __init__(self, step):
+        self._step = step
+
+    def __enter__(self):
+        return step_begin(self._step)
+
+    def __exit__(self, *exc):
+        step_end()
+        return False
+
+
+def step_scope(step=None):
+    """``with telemetry.step_scope(): ...`` — begin/end a timeline step."""
+    return _StepScope(step)
+
+
+def timeline():
+    """Completed step records, oldest first (bounded ring)."""
+    with _LOCK:
+        return [dict(r, phases=dict(r["phases"])) for r in _STEPS]
+
+
+# --------------------------------------------------------------------------
+# compile-event tracer
+# --------------------------------------------------------------------------
+_COMPILE_CAP = max(1, _env.get_int("MXNET_TELEMETRY_COMPILE_EVENTS", 512))
+_COMPILE_EVENTS: deque = deque(maxlen=_COMPILE_CAP)
+
+_COMPILES_TOTAL = counter(
+    "mxnet_compile_events_total",
+    "fresh jax.jit traces by kind (op/block/train_step) and cause",
+    labelnames=("kind", "cause"))
+_COMPILE_HIST = histogram(
+    "mxnet_compile_seconds",
+    "elapsed trace+compile (+first run for ops) per fresh jit",
+    labelnames=("kind",))
+
+
+def compile_event(kind, name, elapsed_s, cause):
+    """Record one fresh jit trace.  ``kind``: ``op`` (dispatch cache miss),
+    ``block`` (hybridized Gluon block build), ``train_step``.  ``cause``
+    names why a new executable was needed (``new_op``/``new_shape``/
+    ``new_dtype``/``new_attrs``/``mode_change``/``recompile``/
+    ``trace_failure``/...)."""
+    now = time.perf_counter()
+    with _LOCK:
+        _COMPILE_EVENTS.append({"kind": kind, "name": name,
+                                "elapsed_s": float(elapsed_s),
+                                "cause": cause, "time": time.time()})
+    _COMPILES_TOTAL.labels(kind=kind, cause=cause).inc()
+    _COMPILE_HIST.labels(kind=kind).observe(elapsed_s)
+    _chrome_span(f"compile:{kind}:{name}", now - float(elapsed_s), now,
+                 "compile")
+
+
+def compile_events():
+    """Recorded compile events, oldest first (bounded ring)."""
+    with _LOCK:
+        return [dict(e) for e in _COMPILE_EVENTS]
+
+
+# --------------------------------------------------------------------------
+# built-in collectors: dispatch cache + fault seams (scraped, not mirrored)
+# --------------------------------------------------------------------------
+def _dispatch_cache_collector():
+    from .ndarray import dispatch_cache as _dc
+
+    s = _dc.stats()
+    def fam(name, mtype, help, value):
+        return {"name": name, "type": mtype, "help": help,
+                "samples": [({}, value)]}
+    return [
+        fam("mxnet_dispatch_cache_hits_total", "counter",
+            "eager jit-cache hits", s["hits"]),
+        fam("mxnet_dispatch_cache_misses_total", "counter",
+            "eager jit-cache misses (fresh compiles)", s["misses"]),
+        fam("mxnet_dispatch_cache_evictions_total", "counter",
+            "eager jit-cache LRU evictions", s["evictions"]),
+        fam("mxnet_dispatch_cache_bypasses_total", "counter",
+            "eager jit-cache bypasses (unhashable/tracer/blocked)",
+            s["bypasses"]),
+        fam("mxnet_dispatch_cache_size", "gauge",
+            "cached executables", s["size"]),
+        fam("mxnet_dispatch_cache_capacity", "gauge",
+            "executable LRU capacity", s["capacity"]),
+        fam("mxnet_dispatch_cache_enabled", "gauge",
+            "1 while the eager jit fast path is on", int(s["enabled"])),
+    ]
+
+
+def _fault_collector():
+    from . import fault as _fault
+
+    s = _fault.stats()
+    out = []
+    for metric, help in (("calls", "seam traversals"),
+                         ("trips", "injected/observed seam failures"),
+                         ("retries", "transient-error retries")):
+        out.append({
+            "name": f"mxnet_fault_seam_{metric}_total", "type": "counter",
+            "help": help,
+            "samples": [({"seam": seam}, c[metric])
+                        for seam, c in sorted(s.items())]})
+    return out
+
+
+register_collector(_dispatch_cache_collector)
+register_collector(_fault_collector)
+
+
+# --------------------------------------------------------------------------
+# exporters
+# --------------------------------------------------------------------------
+def _collected_families():
+    with _LOCK:
+        collectors = list(_COLLECTORS)
+    out = []
+    for fn in collectors:
+        try:
+            out.extend(fn())
+        except Exception:   # a broken collector must not kill the scrape
+            continue
+    return out
+
+
+def _escape_label(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v):
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus():
+    """Prometheus text exposition (version 0.0.4) of every registered
+    family plus collector output."""
+    lines = []
+    with _LOCK:
+        families = list(_FAMILIES.values())
+    for fam in families:
+        lines.append(f"# HELP {fam.name} {fam.help}")
+        lines.append(f"# TYPE {fam.name} {fam.type}")
+        for values, child in fam.children():
+            labels = dict(zip(fam.labelnames, values))
+            if fam.type == "histogram":
+                for le, cum in child.cumulative():
+                    bl = dict(labels)
+                    bl["le"] = _fmt_value(le)
+                    lines.append(f"{fam.name}_bucket{_fmt_labels(bl)} {cum}")
+                lines.append(f"{fam.name}_sum{_fmt_labels(labels)} "
+                             f"{_fmt_value(child.sum)}")
+                lines.append(f"{fam.name}_count{_fmt_labels(labels)} "
+                             f"{child.count}")
+            else:
+                lines.append(f"{fam.name}{_fmt_labels(labels)} "
+                             f"{_fmt_value(child.value)}")
+    for fd in _collected_families():
+        lines.append(f"# HELP {fd['name']} {fd.get('help', '')}")
+        lines.append(f"# TYPE {fd['name']} {fd['type']}")
+        for labels, value in fd["samples"]:
+            lines.append(f"{fd['name']}{_fmt_labels(labels)} "
+                         f"{_fmt_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def snapshot():
+    """JSON-able snapshot: every metric family (registered + collected),
+    the step timeline, compile events, and aggregate summaries.  Embedded
+    in ``profiler.dump()`` otherData and ``bench.py`` extras."""
+    metrics = {}
+    with _LOCK:
+        families = list(_FAMILIES.values())
+    for fam in families:
+        samples = []
+        for values, child in fam.children():
+            labels = dict(zip(fam.labelnames, values))
+            if fam.type == "histogram":
+                samples.append({
+                    "labels": labels,
+                    "buckets": {_fmt_value(le): cum
+                                for le, cum in child.cumulative()},
+                    "sum": child.sum, "count": child.count})
+            else:
+                samples.append({"labels": labels, "value": child.value})
+        metrics[fam.name] = {"type": fam.type, "help": fam.help,
+                             "samples": samples}
+    for fd in _collected_families():
+        metrics[fd["name"]] = {
+            "type": fd["type"], "help": fd.get("help", ""),
+            "samples": [{"labels": labels, "value": value}
+                        for labels, value in fd["samples"]]}
+    steps = timeline()
+    phase_totals: dict = {}
+    for rec in steps:
+        for pname, dt in rec["phases"].items():
+            phase_totals[pname] = phase_totals.get(pname, 0.0) + dt
+    events = compile_events()
+    # totals come from the counter/histogram families, NOT the bounded
+    # event ring: in a long retrace storm the ring keeps only the tail —
+    # the diagnosis payload must not understate compile pressure exactly
+    # when it is worst
+    with _LOCK:
+        n_compiles = sum(c.value
+                         for _, c in _COMPILES_TOTAL.children())
+        compile_s = sum(h.sum for _, h in _COMPILE_HIST.children())
+    return {
+        "time": time.time(),
+        "metrics": metrics,
+        "steps": steps,
+        "step_phase_totals": phase_totals,
+        "compile_events": events,
+        "compile": {"count": int(n_compiles), "total_s": compile_s,
+                    "events_kept": len(events)},
+    }
+
+
+def reset():
+    """Zero every registered family and clear the timeline + compile ring
+    (test isolation; collectors' sources have their own reset_stats)."""
+    global _CUR
+    with _LOCK:
+        for fam in _FAMILIES.values():
+            for values in list(fam._children):
+                fam._children[values] = fam._new_child()
+            if not fam.labelnames:
+                fam._children.setdefault((), fam._new_child())
+        _STEPS.clear()
+        _COMPILE_EVENTS.clear()
+        _CUR = None
+        _STEP_SEQ[0] = 0
+
+
+# --------------------------------------------------------------------------
+# HTTP endpoint (opt-in: MXNET_TELEMETRY_PORT or start_http_server)
+# --------------------------------------------------------------------------
+_HTTP_SERVER = None
+_HTTP_THREAD = None
+
+
+def start_http_server(port=None, addr="127.0.0.1"):
+    """Serve ``/metrics`` (Prometheus text), ``/snapshot`` (JSON), and
+    ``/healthz`` on a daemon thread.  ``port=0`` picks a free port; the
+    bound port is on the returned server (``server_address[1]``).
+    Idempotent: a second call returns the running server."""
+    global _HTTP_SERVER, _HTTP_THREAD
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    if port is None:
+        port = _env.get_int("MXNET_TELEMETRY_PORT", 0)
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path in ("/metrics", "/"):
+                body = render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path in ("/snapshot", "/json"):
+                body = json.dumps(snapshot()).encode()
+                ctype = "application/json"
+            elif path == "/healthz":
+                body = b"ok\n"
+                ctype = "text/plain"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):   # no per-scrape stderr spam
+            pass
+
+    # check-and-create under one lock section: two racing callers must not
+    # each bind a server (the loser's socket/thread would leak unreachable)
+    with _LOCK:
+        if _HTTP_SERVER is not None:
+            return _HTTP_SERVER
+        server = ThreadingHTTPServer((addr, int(port)), _Handler)
+        server.daemon_threads = True
+        thread = threading.Thread(target=server.serve_forever,
+                                  name="mxnet-telemetry-http", daemon=True)
+        thread.start()
+        _HTTP_SERVER, _HTTP_THREAD = server, thread
+        return server
+
+
+def stop_http_server():
+    """Shut the background endpoint down (idempotent)."""
+    global _HTTP_SERVER, _HTTP_THREAD
+    with _LOCK:
+        server, thread = _HTTP_SERVER, _HTTP_THREAD
+        _HTTP_SERVER = _HTTP_THREAD = None
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    if thread is not None:
+        thread.join(timeout=5)

@@ -332,3 +332,64 @@ def test_trace_failure_is_per_key_not_per_op():
     bad2 = nd.invoke(name, [x], {"concrete": True})
     np.testing.assert_allclose(bad2.asnumpy(), np.full((3,), 4.0), rtol=1e-6)
     assert name in nd.dispatch_stats()["blocklisted"]    # reported
+
+
+def test_repeated_failure_of_one_key_never_blocklists_op():
+    """ROADMAP open item (fixed in ISSUE 3): LRU eviction of a single
+    trace-incompatible variant's eager entry re-fails the SAME key on
+    every retrace — that must never escalate to blocking the whole op.
+    Only failures on DISTINCT (attrs, avals) keys count toward the
+    threshold."""
+    name = "_test_evict_refail_op"
+    key = (name, (("concrete", ("bool", "True")),), (((3,), "float32"),),
+           None, "cpu", False)
+    for _ in range(5):          # same key re-failing (eviction-driven)
+        dc.mark_unsafe(name, key)
+    assert not dc.is_blocked(name)
+    assert dc.stats()["trace_failures"][name] == 1
+    # distinct keys DO escalate
+    for i in range(3):
+        k = (name, (("concrete", ("bool", "True")),), (((3 + i, 7), "float32"),),
+             None, "cpu", False)
+        dc.mark_unsafe(name, k)
+    assert dc.is_blocked(name)
+
+
+def test_eviction_refail_integration_keeps_fast_path():
+    """End-to-end: capacity-1 cache forces the failing variant's eager
+    entry out between calls; the op must keep the jit fast path for its
+    good variant instead of getting blocklisted."""
+    from mxnet_tpu.ops.registry import register, OP_TABLE
+
+    name = "_test_evict_partial_unsafe_op"
+    if name not in OP_TABLE:
+        @register(name, differentiable=False)
+        def _partial(x, concrete=False):
+            if concrete:
+                return x + float(np.asarray(x).sum())  # breaks under trace
+            return x + 1.0
+
+    prev_cap = dc.capacity()
+    dc.set_capacity(1)
+    try:
+        x = nd.array(np.ones((3,), "f"))
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            for _ in range(4):
+                bad = nd.invoke(name, [x], {"concrete": True})  # re-traces,
+                np.testing.assert_allclose(                      # re-fails
+                    bad.asnumpy(), np.full((3,), 4.0), rtol=1e-6)
+                # evict the eager entry so the next call must re-trace
+                nd.invoke(name, [x], {"concrete": False})
+        assert not dc.is_blocked(name)
+        dc.reset_stats()
+        good = nd.invoke(name, [x], {"concrete": False})
+        np.testing.assert_allclose(good.asnumpy(), np.full((3,), 2.0),
+                                   rtol=1e-6)
+        per = nd.dispatch_stats()["per_op"][name]
+        # still served through the cache (hit of the surviving entry or a
+        # fresh jit miss) — a blocklisted op would count a bypass instead
+        assert per["bypasses"] == 0 and per["hits"] + per["misses"] == 1
+    finally:
+        dc.set_capacity(prev_cap)

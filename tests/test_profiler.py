@@ -82,3 +82,64 @@ def test_profiling_off_has_no_overhead_path():
     assert _PROFILE["on"] is False
     nd.ones((4,)).wait_to_read()
     assert not profiler.dumps(reset=True).count("ones")
+
+
+def test_continuous_dump_drains_and_merges(tmp_path):
+    """set_config(continuous_dump=True) was accepted but ignored, and
+    repeated dump() calls re-emitted every event (ISSUE 3 satellite):
+    with continuous dump each dump() drains the buffer and MERGES the
+    increment into the file — each op appears exactly once."""
+    f = str(tmp_path / "cont.json")
+    profiler.set_config(profile_imperative=True, filename=f, jax_trace=False,
+                        continuous_dump=True)
+    profiler.start()
+    try:
+        nd.sqrt(nd.ones((4,))).wait_to_read()
+        profiler.dump()
+        nd.exp(nd.ones((4,))).wait_to_read()
+        profiler.dump()
+    finally:
+        profiler.stop()
+        profiler.dumps(reset=True)
+        profiler.set_config(profile_imperative=False, jax_trace=True)
+    trace = json.load(open(f))
+    names = [e["name"] for e in trace["traceEvents"]
+             if e.get("cat") == "operator"]
+    assert names.count("sqrt") == 1 and names.count("exp") == 1
+
+
+def test_dump_drain_param_without_continuous(prof):
+    nd.sqrt(nd.ones((4,))).wait_to_read()
+    profiler.stop()
+    path = profiler.dump(drain=True)
+    first = [e for e in json.load(open(path))["traceEvents"]
+             if e.get("cat") == "operator"]
+    assert any(e["name"] == "sqrt" for e in first)
+    # drained: a second dump (full-rewrite mode) has no stale op events
+    path = profiler.dump()
+    second = [e for e in json.load(open(path))["traceEvents"]
+              if e.get("cat") == "operator"]
+    assert second == []
+
+
+def test_default_dump_is_idempotent_full_snapshot(prof):
+    """Without continuous_dump/drain the legacy contract holds: dump() is
+    a full snapshot and repeating it rewrites the same events."""
+    nd.sqrt(nd.ones((4,))).wait_to_read()
+    profiler.stop()
+    a = json.load(open(profiler.dump()))["traceEvents"]
+    b = json.load(open(profiler.dump()))["traceEvents"]
+    assert a == b
+
+
+def test_dump_embeds_telemetry_snapshot(prof):
+    from mxnet_tpu import telemetry
+
+    telemetry.step_begin()
+    with telemetry.phase("data"):
+        pass
+    telemetry.step_end()
+    profiler.stop()
+    other = json.load(open(profiler.dump()))["otherData"]
+    assert "telemetry" in other
+    assert other["telemetry"]["steps"]
