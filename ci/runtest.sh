@@ -12,6 +12,10 @@
 #   telemetry   runtime-telemetry smoke (train loop with telemetry +
 #               profiler on; Prometheus/snapshot/compile-event checks)
 #               + the telemetry unit suite
+#   overlap     step-overlap smoke (prefetch + bucketed allreduce +
+#               async checkpoint on CPU; exact fused-collective count,
+#               data-phase shrink, SIGKILL fail-fast) + the overlap
+#               unit suite
 #   sanity      import + flake-level checks, no heavy tests
 #   nightly     large-tensor + model backwards-compat tier
 #   bench       headline benchmarks (runs on whatever backend is live)
@@ -59,6 +63,19 @@ case "$LANE" in
     #    cheap (~5s)
     JAX_PLATFORMS=cpu python -m pytest -q tests/test_telemetry.py
     ;;
+  overlap)
+    # 1) end-to-end smoke through the PUBLIC surface: 5-step loop with
+    #    DataLoader(prefetch_to_device=...) + default bucketing + async
+    #    saves; asserts prefetch hits, the EXACT fused-collective count,
+    #    a shrinking data phase, and worker-SIGKILL fail-fast through
+    #    the prefetch thread (PR 2 liveness deadline)
+    JAX_PLATFORMS=cpu python ci/overlap_smoke.py
+    # 2) the unit suite (bucket determinism, bit-exact trajectories,
+    #    byte accounting, async-checkpoint failure domains).  The unit
+    #    lane also runs this file; the repeat is deliberate — the
+    #    overlap stage must stay green/triagable on its own (~10s)
+    JAX_PLATFORMS=cpu python -m pytest -q tests/test_overlap.py
+    ;;
   nightly)
     # large-tensor + model backwards-compatibility tier (reference:
     # tests/nightly/ + model_backwards_compatibility_check/); set
@@ -69,7 +86,7 @@ case "$LANE" in
     python bench.py | tee BENCH.json
     ;;
   *)
-    echo "unknown lane: $LANE (unit|tpu|dist|chaos|telemetry|sanity|nightly|bench)" >&2
+    echo "unknown lane: $LANE (unit|tpu|dist|chaos|telemetry|overlap|sanity|nightly|bench)" >&2
     exit 2
     ;;
 esac

@@ -39,6 +39,7 @@ import logging
 import os
 import shutil
 import tempfile
+import threading
 import time
 
 from . import fault
@@ -57,6 +58,12 @@ _RESTORES_TOTAL = telemetry.counter(
     "mxnet_checkpoint_restores_total", "completed checkpoint restores")
 _RESTARTS_TOTAL = telemetry.counter(
     "mxnet_recovery_restarts_total", "run_with_recovery restarts")
+_INFLIGHT = telemetry.gauge(
+    "mxnet_checkpoint_inflight",
+    "1 while an async checkpoint write is staging/publishing in background")
+_SNAPSHOT_HIST = telemetry.histogram(
+    "mxnet_checkpoint_snapshot_seconds",
+    "blocking device->host snapshot portion of an async save")
 
 _LOGGER = logging.getLogger(__name__)
 
@@ -128,6 +135,11 @@ class CheckpointManager:
         # or the next restart's start step disagrees with the weights
         # restore() actually falls back to
         self._load_failed = set()
+        # async-save state: at most ONE background write in flight; the
+        # next save()/close()/restore() joins it first
+        self._pending = None
+        self._pending_step = None
+        self._pending_error = None
         os.makedirs(directory, exist_ok=True)
         # only the writing process sweeps: a non-primary peer constructing
         # its manager while process 0 is mid-save must not delete the live
@@ -202,6 +214,10 @@ class CheckpointManager:
         Resume logic must use THIS, not ``latest_step()``: after
         corruption the two differ, and trusting the unverified number
         silently skips the corrupted step's work."""
+        # an in-flight async write may be about to publish (or to mutate
+        # the verify cache): join first so the answer is race-free and
+        # credits exactly the published steps
+        self._join_pending(raise_=False)
         for s in reversed(self.all_steps()):
             if s not in self._load_failed and self.verify(s) is None:
                 return s
@@ -247,28 +263,25 @@ class CheckpointManager:
         return None
 
     # -- save/restore ------------------------------------------------------
-    def save(self, step, net=None, trainer=None, extra=None):
-        """Publish checkpoint `step` atomically; returns its directory."""
-        import jax
-
-        primary = jax.process_index() == 0
+    def _write_step(self, step, write_payloads, extra, primary,
+                    barrier=True):
+        """Stage, checksum, fsync, and atomically publish checkpoint
+        ``step``.  ``write_payloads(tmp_dir)`` writes the payload files;
+        everything else (manifest, durability ordering, publish rename,
+        retention GC) is identical for the sync and async paths — the
+        fault seams and sha256 contract hold for both.  ``barrier=False``
+        for the async background writer: a collective issued from a
+        second thread would race the main thread's training collectives
+        (SPMD peers must enqueue collectives in one program order), so
+        the async path barriers on the CALLER's thread instead."""
         final = self._step_dir(step)
-        t0 = time.perf_counter()
-        # a save inside an open telemetry step shows up as its own phase
-        _ph = telemetry.phase("checkpoint")
-        _ph.__enter__()
         try:
             if primary:
                 tmp = tempfile.mkdtemp(prefix=f"{_TMP_PREFIX}{step}_",
                                        dir=self.directory)
                 try:
                     fault.check("checkpoint.write")
-                    if net is not None:
-                        net.save_parameters(
-                            os.path.join(tmp, "model.params"))
-                    if trainer is not None:
-                        trainer.save_states(
-                            os.path.join(tmp, "trainer.states"))
+                    write_payloads(tmp)
                     meta = {"step": int(step), "time": time.time()}
                     if extra:
                         meta["extra"] = extra
@@ -304,16 +317,162 @@ class CheckpointManager:
                 self._gc()
         finally:
             # ALL processes must reach the barrier even when the primary's
-            # write fails — otherwise the peers deadlock in the collective;
-            # and the phase must close even when the BARRIER fails, or the
-            # dangling frame mis-attributes the rest of the step
-            try:
+            # write fails — otherwise the peers deadlock in the collective
+            if barrier:
                 self._barrier()
-            finally:
-                _ph.__exit__(None, None, None)
-        _SAVE_HIST.observe(time.perf_counter() - t0)
-        _SAVES_TOTAL.inc()
         return final
+
+    def save(self, step, net=None, trainer=None, extra=None, async_=None):
+        """Publish checkpoint `step` atomically; returns its directory.
+
+        ``async_=True`` (default from ``MXNET_CHECKPOINT_ASYNC``) makes
+        only the device→host snapshot block the caller: file writes,
+        fsyncs, and the atomic publish run on a background thread with
+        the same fault seams and sha256 manifest.  The next ``save`` (or
+        ``close()``/``restore()``) joins the previous write first — a
+        failed background write surfaces there, and its step was simply
+        never published (costs one step, never the job).  Supervisors
+        must credit progress from ``latest_valid_step()``, which sees
+        only *published* steps."""
+        import jax
+
+        if async_ is None:
+            from . import env as _env
+
+            async_ = _env.checkpoint_async_default()
+        # surface a failed previous background write before anything else:
+        # losing its step already cost one checkpoint; losing the ERROR
+        # would hide a persistently broken disk behind green saves.
+        # Multi-process: LOG instead of raising — only the primary has
+        # pending state, and a primary-only raise here would strand the
+        # peers in the barrier below (the all-processes-reach-the-barrier
+        # invariant).  The unpublished step still never counts as
+        # progress; close() at end-of-job (no more collectives) raises.
+        self._join_pending(raise_=jax.process_count() == 1)
+        primary = jax.process_index() == 0
+        final = self._step_dir(step)
+        t0 = time.perf_counter()
+        if not async_:
+            def write_payloads(tmp):
+                if net is not None:
+                    net.save_parameters(os.path.join(tmp, "model.params"))
+                if trainer is not None:
+                    trainer.save_states(os.path.join(tmp, "trainer.states"))
+
+            # a save inside an open telemetry step is its own phase; the
+            # phase must close even when the barrier fails, or the
+            # dangling frame mis-attributes the rest of the step
+            with telemetry.phase("checkpoint"):
+                self._write_step(step, write_payloads, extra, primary)
+            _SAVE_HIST.observe(time.perf_counter() - t0)
+            _SAVES_TOTAL.inc()
+            return final
+        # async: snapshot device→host NOW (host copies — the step loop
+        # mutating params right after cannot leak into the file), write
+        # and publish in background.  The peer barrier runs HERE, on the
+        # calling thread: every process calls save() at the same point of
+        # its step loop, so the collective stays in program order; a
+        # barrier from the background thread would race the main thread's
+        # training collectives and desync SPMD peers.  The synchronized
+        # event is therefore "snapshot taken everywhere", and the publish
+        # is primary-local — supervisors credit only PUBLISHED steps.
+        with telemetry.phase("checkpoint"):
+            try:
+                writers = self._snapshot_payloads(net, trainer) if primary \
+                    else {}
+            finally:
+                # ALL processes must reach the barrier even when the
+                # primary's snapshot raises (same invariant as the sync
+                # path's finally in _write_step) — peers are already
+                # blocked in it
+                self._barrier()
+        _SNAPSHOT_HIST.observe(time.perf_counter() - t0)
+        if not primary:
+            return final  # nothing to write; the snapshot barrier is done
+
+        def write_payloads(tmp):
+            for name, write in writers.items():
+                write(os.path.join(tmp, name))
+
+        self._pending_step = step
+        self._pending_error = None
+        _INFLIGHT.set(1)
+
+        def task():
+            try:
+                # NO telemetry.phase here: the step timeline is the MAIN
+                # thread's; a background frame would corrupt attribution
+                self._write_step(step, write_payloads, extra, primary,
+                                 barrier=False)
+                _SAVE_HIST.observe(time.perf_counter() - t0)
+                _SAVES_TOTAL.inc()
+            except BaseException as e:
+                self._pending_error = e
+            finally:
+                _INFLIGHT.set(0)
+
+        self._pending = threading.Thread(
+            target=task, name=f"mxnet-ckpt-save-{step}", daemon=True)
+        self._pending.start()
+        return final
+
+    def _snapshot_payloads(self, net, trainer):
+        """Host-resident copies of everything save() would write, as
+        path-writer callables — the blocking (D2H) half of an async save."""
+        import numpy as _np
+
+        writers = {}
+        if net is not None:
+            snap = {k: _np.array(_np.asarray(v.data()._get()))
+                    for k, v in net._collect_params_with_prefix().items()}
+
+            def write_params(path, _snap=snap):
+                from .ndarray.serialization import save as _save
+
+                _save(path, _snap)
+
+            writers["model.params"] = write_params
+        if trainer is not None:
+            blob = trainer._states_blob()
+
+            def write_states(path, _blob=blob):
+                with open(path, "wb") as f:
+                    f.write(_blob)
+
+            writers["trainer.states"] = write_states
+        return writers
+
+    def _join_pending(self, raise_=True):
+        """Wait for the in-flight background write (if any); re-raise its
+        failure unless ``raise_=False`` (then it is logged and dropped —
+        the unpublished step is the cost)."""
+        t = self._pending
+        if t is not None:
+            t.join()
+            self._pending = None
+        err, self._pending_error = self._pending_error, None
+        if err is None:
+            return
+        if raise_:
+            raise MXNetError(
+                f"async checkpoint write for step {self._pending_step} "
+                f"failed: {err!r}") from err
+        self.logger.warning(
+            "async checkpoint write for step %s failed (%r); that step "
+            "was never published", self._pending_step, err)
+
+    def close(self):
+        """Join the in-flight async write; raises if it failed.  Call at
+        the end of training (or use the manager as a context manager)."""
+        self._join_pending()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        # don't mask an in-flight exception with the join's verdict
+        self._join_pending(raise_=exc[0] is None)
+        return False
 
     def restore(self, net=None, trainer=None, step=None, ctx=None):
         """Load the newest VALID checkpoint (default), or exactly ``step``
@@ -327,6 +486,10 @@ class CheckpointManager:
         the strict contract: the caller pinned that checkpoint
         (reproduction run, eval of a named step), so serving different
         weights would be silent corruption — missing or invalid raises."""
+        # loading while a background save is staging/publishing would race
+        # the writer (and the verify cache); a FAILED background write is
+        # logged and costs its (never-published) step only
+        self._join_pending(raise_=False)
         t0 = time.perf_counter()
         if step is not None:
             if step not in self.all_steps():
@@ -433,12 +596,34 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
     while True:
         start = progress() or 0
         try:
-            return train_fn(start, manager)
+            result = train_fn(start, manager)
+            # a final async save may still be staging: join before the
+            # supervisor returns (daemon writer threads die with the
+            # interpreter).  Single-process, a FAILED final write raises
+            # here, inside the try, so it re-enters the retry loop and
+            # the lost step is re-trained instead of silently dropped.
+            # Multi-process it is only logged: peers have already
+            # returned, and a primary-only retry would desync their
+            # collectives — the lost step escalates to the external
+            # whole-job supervisor (PR 2's SPMD-restart philosophy).
+            join = getattr(manager, "_join_pending", None)
+            if join is not None:
+                import jax
+
+                join(raise_=jax.process_count() == 1)
+            return result
         except KeyboardInterrupt:
             raise
         except Exception as e:
             if should_retry is not None and not should_retry(e):
                 raise
+            # a background checkpoint write may still be in flight from
+            # before the failure: let it finish (it may publish the step
+            # that resets the budget) before judging progress — a FAILED
+            # write is logged and its step simply never counts
+            join = getattr(manager, "_join_pending", None)
+            if join is not None:
+                join(raise_=False)
             step_now = progress() or 0
             if last_failed_step is not None and step_now > last_failed_step:
                 log.info("checkpoint advanced %s -> %s between failures; "

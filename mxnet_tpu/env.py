@@ -57,6 +57,15 @@ Wired vars (read at ``import mxnet_tpu``):
   (completed per-step phase records kept for snapshot(); default 256).
 - ``MXNET_TELEMETRY_COMPILE_EVENTS``: compile-event ring capacity
   (fresh jax.jit traces kept with elapsed + cause; default 512).
+- ``MXNET_PREFETCH_BUFFER``: device-prefetch queue depth for
+  ``DataLoader(prefetch_to_device=...)`` / ``TrainStep.run`` (default 2;
+  0 disables the background pipeline — see gluon/data/prefetcher.py).
+- ``MXNET_ALLREDUCE_BUCKET_MB``: gradient-bucket size cap in MiB for the
+  fused allreduce path (default 32; 0 disables fusion and every key gets
+  its own collective — see parallel/bucketing.py).
+- ``MXNET_CHECKPOINT_ASYNC``: default for ``CheckpointManager.save``'s
+  ``async_`` parameter (0/unset = synchronous saves; explicit
+  ``async_=`` always wins).
 
 Accepted-but-subsumed (XLA owns the concern; reads return the default and
 ``describe()`` says why):
@@ -125,6 +134,24 @@ def kvstore_bigarray_bound():
     return get_int("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000)
 
 
+def prefetch_buffer():
+    """Device-prefetch queue depth (MXNET_PREFETCH_BUFFER, default 2;
+    0 disables the background prefetch pipeline)."""
+    return max(0, get_int("MXNET_PREFETCH_BUFFER", 2))
+
+
+def allreduce_bucket_mb():
+    """Fused-allreduce gradient-bucket cap in MiB
+    (MXNET_ALLREDUCE_BUCKET_MB, default 32; 0 disables fusion)."""
+    return max(0, get_int("MXNET_ALLREDUCE_BUCKET_MB", 32))
+
+
+def checkpoint_async_default():
+    """Default for CheckpointManager.save(async_=None)
+    (MXNET_CHECKPOINT_ASYNC, default off)."""
+    return get_bool("MXNET_CHECKPOINT_ASYNC", False)
+
+
 def describe():
     """One line per known var: current value and what it maps to."""
     lines = []
@@ -161,6 +188,13 @@ def describe():
          "(default 256; mxnet_tpu.telemetry)"),
         ("MXNET_TELEMETRY_COMPILE_EVENTS", "compile-event ring capacity "
          "(default 512; mxnet_tpu.telemetry)"),
+        ("MXNET_PREFETCH_BUFFER", "device-prefetch queue depth "
+         "(default 2; 0 = no background pipeline; "
+         "gluon/data/prefetcher.py)"),
+        ("MXNET_ALLREDUCE_BUCKET_MB", "fused-allreduce bucket cap in MiB "
+         "(default 32; 0 = per-key collectives; parallel/bucketing.py)"),
+        ("MXNET_CHECKPOINT_ASYNC", "default for CheckpointManager.save "
+         "async_ (unset/0 = synchronous saves)"),
     ]
     for name, what in wired:
         lines.append(f"{name}={os.environ.get(name, '<unset>')} — {what}")

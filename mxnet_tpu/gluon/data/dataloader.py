@@ -143,7 +143,13 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=True):
+                 thread_pool=True, prefetch_to_device=None):
+        """``prefetch_to_device``: overlap host→device staging with the
+        training step (gluon/data/prefetcher.py).  ``True`` prefetches to
+        the default device; a ``jax.sharding.Sharding`` (e.g. a
+        TrainStep's ``_batch_shard``) places the global batch.  Depth is
+        ``MXNET_PREFETCH_BUFFER`` (default 2; 0 turns the pipeline off
+        and batches stage inline on the consumer's thread)."""
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -159,6 +165,7 @@ class DataLoader:
         self._num_workers = num_workers
         self._thread_pool = thread_pool
         self._prefetch = max(0, prefetch or 2 * max(num_workers, 1))
+        self._prefetch_to_device = prefetch_to_device
         self._proc_pool = None          # persistent process pool (spawn is
         self._proc_pool_method = None   # expensive: pay startup once)
         self._pool_finalizer = None
@@ -171,17 +178,31 @@ class DataLoader:
         # batch-wait attribution: time from the consumer asking for the
         # next batch to it being ready — with a prefetching pool this is
         # the stall the training loop actually feels, the "data wait"
-        # answer to "why was this step slow?"
+        # answer to "why was this step slow?"  The device prefetcher sits
+        # INSIDE this measurement so the histogram shows the shrink.
         it = self._iter_impl()
-        while True:
-            t0 = _time.perf_counter()
-            try:
-                batch = next(it)
-            except StopIteration:
-                return
-            _BATCH_WAIT.observe(_time.perf_counter() - t0)
-            _BATCHES_TOTAL.inc()
-            yield batch
+        pf = None
+        if self._prefetch_to_device:
+            from .prefetcher import PrefetchIterator
+
+            sharding = self._prefetch_to_device \
+                if self._prefetch_to_device is not True else None
+            pf = it = PrefetchIterator(it, sharding=sharding)
+        try:
+            while True:
+                t0 = _time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                _BATCH_WAIT.observe(_time.perf_counter() - t0)
+                _BATCHES_TOTAL.inc()
+                yield batch
+        finally:
+            # runs on exhaustion, break, and generator GC alike — a
+            # SIGKILLed worker's error must not strand the prefetch thread
+            if pf is not None:
+                pf.close()
 
     def _iter_impl(self):
         if self._num_workers == 0:

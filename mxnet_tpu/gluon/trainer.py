@@ -55,6 +55,7 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._telemetry = bool(telemetry)
+        self._bucketer = None   # fused-allreduce plan cache (lazy)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -132,6 +133,11 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        from ..parallel import bucketing as _bucketing
+
+        if not self._update_on_kvstore and _bucketing.bucket_cap_bytes() > 0:
+            self._allreduce_grads_bucketed()
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue
@@ -142,6 +148,89 @@ class Trainer:
             else:
                 self._kvstore.push(i, param.list_grad())
                 self._kvstore.pull(i, param.list_grad())
+
+    def _allreduce_grads_bucketed(self):
+        """Coalesce dense gradients into size-capped flat buckets: K
+        per-parameter push/pull round trips become one per bucket
+        (parallel/bucketing.py — ceil(total/cap) fused collectives on the
+        dist store, one reduce + compression round-trip per bucket
+        locally).  Assignment is deterministic in parameter order, so
+        every SPMD process issues identical collectives.  Row-sparse and
+        host-promoted keys bypass the buckets and keep the per-key path —
+        their payload is touched rows, not a stable flat span."""
+        from ..ndarray.ndarray import NDArray
+        from ..ndarray.sparse import RowSparseNDArray
+        from ..parallel import bucketing as _bucketing
+
+        active = []
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            active.append((i, param.list_grad()))
+        if not active:
+            return
+        ndev = len(active[0][1])
+        grads_by_idx = dict(active)
+        entries, bypass = [], []
+        for i, grads in active:
+            if (isinstance(grads[0], RowSparseNDArray)
+                    or len(grads) != ndev
+                    or self._kvstore._is_host_key(i)):
+                bypass.append(i)
+            else:
+                entries.append((i, tuple(grads[0].shape),
+                                str(grads[0].dtype)))
+        if self._bucketer is None:
+            # cap=None: plan_for re-reads the env knob per call and folds
+            # it into the plan signature, so a mid-run cap change replans
+            self._bucketer = _bucketing.Bucketer()
+        plan = self._bucketer.plan_for(entries)
+        gen = self._bucketer.generation
+        if getattr(self, "_bucket_gen_seen", None) != gen:
+            # a replan retired the previous generation's bucket keys for
+            # good: drop their compression residuals (flat arrays up to a
+            # full bucket each) or an oscillating signature leaks them
+            self._bucket_gen_seen = gen
+            comp = getattr(self._kvstore, "_compression", None)
+            if comp is not None and hasattr(comp, "drop_residuals"):
+                comp.drop_residuals(
+                    lambda k: isinstance(k, str)
+                    and k.startswith("__grad_bucket")
+                    and not k.endswith(f"g{gen}"))
+        for b in plan.buckets:
+            if not b.fused:
+                # singleton (oversized or lone dtype): per-key round trip,
+                # no pack/unpack overhead
+                (i,) = b.keys
+                self._kvstore.push(i, grads_by_idx[i])
+                self._kvstore.pull(i, grads_by_idx[i])
+                continue
+            # the plan generation is part of the key: compression
+            # error-feedback residuals are keyed per kvstore key, and a
+            # replanned bucket with different composition must not
+            # inherit (or shape-clash with) the old plan's residual
+            key = f"__grad_bucket{b.index}g{self._bucketer.generation}"
+            flats = []
+            for j in range(ndev):
+                flat = _bucketing.pack(
+                    [grads_by_idx[i][j]._get() for i in b.keys])
+                flats.append(NDArray._from_jax(
+                    flat, grads_by_idx[b.keys[0]][j].context))
+            self._kvstore.push(key, flats)
+            self._kvstore.pull(key, flats)
+            # the reduced flat must not stay resident in the store: that
+            # would duplicate the whole dense-grad footprint in HBM
+            self._kvstore._discard_transient(key)
+            _bucketing.record_fused(b.nbytes)
+            for j in range(ndev):
+                for i, part in zip(b.keys,
+                                   _bucketing.unpack(b, flats[j]._get())):
+                    g = grads_by_idx[i][j]
+                    g._set(part.astype(g._get().dtype))
+        for i in bypass:
+            _bucketing.record_bypass()
+            self._kvstore.push(i, grads_by_idx[i])
+            self._kvstore.pull(i, grads_by_idx[i])
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -160,13 +249,18 @@ class Trainer:
             for w, g in zip(param.list_data(), param.list_grad()):
                 updater(i, g, w)
 
+    def _states_blob(self):
+        """The bytes ``save_states`` writes — exposed so async
+        checkpointing can snapshot optimizer state on the step loop's
+        thread and hand only the file I/O to a background writer."""
+        if self._update_on_kvstore and self._kvstore is not None:
+            return self._kvstore._optimizer_states_blob(dump_optimizer=True)
+        return self._updaters[0].get_states(dump_optimizer=True)
+
     def save_states(self, fname):
         """Reference: Trainer.save_states (optimizer state round-trip)."""
-        if self._update_on_kvstore and self._kvstore is not None:
-            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
-        else:
-            with open(fname, "wb") as f:
-                f.write(self._updaters[0].get_states(dump_optimizer=True))
+        with open(fname, "wb") as f:
+            f.write(self._states_blob())
 
     def load_states(self, fname):
         if not self._kv_initialized:

@@ -206,6 +206,21 @@ class KVStore:
         self.push(key, value, priority)
         self.pull(key, out if out is not None else value, priority)
 
+    def _is_host_key(self, key):
+        """True when ``key``'s stored value is a host-resident row-sparse
+        table — bucketing callers (gluon.Trainer) must route such keys
+        per-key: their traffic is touched rows, not a stable flat span."""
+        return isinstance(self._store.get(str(key)), _HostRowSparseTable)
+
+    def _discard_transient(self, key):
+        """Drop a transient (gradient-bucket) key's stored value after
+        its pull: the flat buffers would otherwise duplicate the model's
+        entire dense-gradient footprint in device memory for the rest of
+        the run (and a replan would strand old-plan buffers forever)."""
+        k = str(key)
+        self._store.pop(k, None)
+        self._dense_pushed.discard(k)
+
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (reference: the dist server's
         DataHandleRowSparse, src/kvstore/kvstore_dist_server.h — SURVEY.md
@@ -455,19 +470,24 @@ class KVStore:
     # predate the bundled format still load variant-1 files unchanged.
     _STATE_MAGIC = b"MXKVOPT1"
 
-    def save_optimizer_states(self, fname, dump_optimizer=False):
+    def _optimizer_states_blob(self, dump_optimizer=False):
+        """The bytes ``save_optimizer_states`` writes — exposed so async
+        checkpointing can snapshot the state on the step loop's thread and
+        hand the file I/O to a background writer."""
         if self._updater is None:
             raise MXNetError("no updater attached")
         blob = self._updater.get_states(dump_optimizer)
         host = {k: v.state for k, v in self._store.items()
                 if isinstance(v, _HostRowSparseTable) and v.state is not None}
+        if host:
+            return self._STATE_MAGIC + pickle.dumps(
+                {"updater": blob, "host_states": host})
+        return blob
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        blob = self._optimizer_states_blob(dump_optimizer)
         with open(fname, "wb") as f:
-            if host:
-                f.write(self._STATE_MAGIC)
-                f.write(pickle.dumps({"updater": blob,
-                                      "host_states": host}))
-            else:
-                f.write(blob)
+            f.write(blob)
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
@@ -518,6 +538,13 @@ class TwoBitCompression:
         self.threshold = threshold
         self._residuals = {}
 
+    def drop_residuals(self, match):
+        """Forget residual state for keys where ``match(key)`` is true —
+        called when a transient (bucket) key will never be pushed again,
+        so its flat residual array does not leak for the process's life."""
+        for k in [k for k in self._residuals if match(k)]:
+            del self._residuals[k]
+
     def round_trip(self, grad_nd, key=None):
         import jax.numpy as jnp
 
@@ -562,6 +589,7 @@ class DistTPUSyncKVStore(KVStore):
     def __init__(self, kind="dist_tpu_sync"):
         super().__init__(kind)
         self._mesh = None
+        self._fuse_bucketer = None  # deterministic fusion plan cache
 
     @property
     def rank(self):
@@ -622,7 +650,7 @@ class DistTPUSyncKVStore(KVStore):
         if self.num_workers > 1 and not (
                 getattr(self, "_sharded_update", False)
                 and self._updater is not None):
-            reduced_list = self._allreduce_bucketed(reduced_list)
+            reduced_list = self._allreduce_bucketed(reduced_list, keys)
         # int8 compression happens INSIDE the bucketed collective; a host
         # round-trip afterwards would quantize the already-summed gradient
         # a second time
@@ -649,53 +677,72 @@ class DistTPUSyncKVStore(KVStore):
             else:
                 self._store[k] = reduced
 
-    def _allreduce_bucketed(self, nds):
+    def _allreduce_bucketed(self, nds, keys=None):
         """Cross-host allreduce: jax makes a global array over the dp mesh
         and psums it (rides ICI within a slice, DCN across slices).
 
-        Values under MXNET_KVSTORE_BIGARRAY_BOUND elements are fused into
-        one flat collective per push call (≙ the reference's bigarray
-        bound deciding per-key vs bucketed server traffic); larger values
-        get their own collective."""
-        import jax.numpy as jnp
-
+        Fusion (parallel/bucketing.py): values under
+        MXNET_KVSTORE_BIGARRAY_BOUND elements ride dtype-segregated flat
+        buckets capped at MXNET_ALLREDUCE_BUCKET_MB (deterministic
+        assignment in push order, cached across steps — every SPMD peer
+        issues the identical collective sequence); larger values — and
+        everything when the cap is 0 — get their own collective.  The
+        per-key ``kvstore_push_bytes`` accounting happened in ``push``;
+        fused flat-buffer bytes are counted ONCE per bucket in the
+        separate ``mxnet_allreduce_bucket_*`` families, never re-added to
+        the push counter."""
         from . import env
+        from .parallel import bucketing as _bucketing
         from .parallel.collectives import allreduce_hosts
 
         bound = env.kvstore_bigarray_bound()
+        cap = _bucketing.bucket_cap_bytes()
         int8 = isinstance(self._compression, Int8Compression)
         reduce_fn = allreduce_hosts
         if int8:
-            # quantize inside the collective; the fused bucket keeps a
+            # quantize inside the collective; fused buckets keep a
             # PER-TENSOR scale so small-magnitude grads keep resolution
             from .parallel.collectives import allreduce_hosts_quantized
 
             reduce_fn = allreduce_hosts_quantized
         vals = [nd._get() for nd in nds]
-        small = [i for i, v in enumerate(vals)
-                 if v.size <= bound and v.dtype == vals[0].dtype]
         out = list(vals)
+        done = set()
+        small = [i for i, v in enumerate(vals) if v.size <= bound] \
+            if cap > 0 else []
+        # a single small value can never fuse: skip the planner entirely,
+        # or the common per-key push pattern (update_on_kvstore trainers)
+        # would thrash the one-slot plan cache on every call
         if len(small) > 1:
-            if int8:
-                from .parallel.collectives import (
-                    allreduce_hosts_quantized_multi)
+            entries = [(keys[i] if keys is not None else i,
+                        tuple(vals[i].shape), str(vals[i].dtype))
+                       for i in small]
+            if self._fuse_bucketer is None:
+                self._fuse_bucketer = _bucketing.Bucketer()
+            plan = self._fuse_bucketer.plan_for(entries)
+            pos = {e[0]: i for e, i in zip(entries, small)}
+            for b in plan.buckets:
+                if not b.fused:
+                    continue  # singleton: per-value collective below
+                members = [pos[k] for k in b.keys]
+                if int8:
+                    from .parallel.collectives import (
+                        allreduce_hosts_quantized_multi)
 
-                fused = allreduce_hosts_quantized_multi(
-                    [vals[i] for i in small])
-                for i, v in zip(small, fused):
-                    out[i] = v
-            else:
-                flat = jnp.concatenate([vals[i].ravel() for i in small])
-                summed = reduce_fn(flat)
-                off = 0
-                for i in small:
-                    n = vals[i].size
-                    out[i] = summed[off:off + n].reshape(vals[i].shape)
-                    off += n
-        else:
-            small = []
+                    fused = allreduce_hosts_quantized_multi(
+                        [vals[i] for i in members])
+                    for i, v in zip(members, fused):
+                        out[i] = v
+                else:
+                    flat = _bucketing.pack([vals[i] for i in members])
+                    summed = reduce_fn(flat)
+                    for i, part in zip(members,
+                                       _bucketing.unpack(b, summed)):
+                        out[i] = part
+                _bucketing.record_fused(b.nbytes)
+                done.update(members)
         for i in range(len(vals)):
-            if i not in small:
+            if i not in done:
                 out[i] = reduce_fn(vals[i])
         return [NDArray._from_jax(v, nd.context)
                 for v, nd in zip(out, nds)]

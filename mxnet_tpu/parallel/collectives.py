@@ -146,19 +146,21 @@ def _combine_with_seam(local_leaves, combine_fn, static_args=()):
                                   static_args=static_args)
 
 
-def allreduce_hosts(value):
+def allreduce_hosts(value, _testing_force=False):
     """Allreduce a host-local array across all processes' devices: builds a
     global array sharded over processes and psums it.  Used by the
     dist_tpu_sync KVStore (single psum ≙ push+pull, SURVEY.md §4.4).
 
     Fault seam ``collectives.allreduce``; see ``_combine_with_seam`` for
     why transient-error retry happens here only single-process (SPMD
-    retry is run_with_recovery's whole-job restart)."""
+    retry is run_with_recovery's whole-job restart).  ``_testing_force``
+    runs the real combine path on one process (tests and the bench's
+    fused-vs-per-key curve, like the quantized variants)."""
     import jax
 
     from .. import fault
 
-    if jax.process_count() == 1:
+    if jax.process_count() == 1 and not _testing_force:
         fault.guard("collectives.allreduce")
         return value
     return _combine_with_seam((value,), _sum_combine)

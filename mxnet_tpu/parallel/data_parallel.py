@@ -349,16 +349,24 @@ class TrainStep:
         merged.update(self.train_params)
         return merged
 
+    def _stage_batch(self, v):
+        """Place one input on device under the step's batch sharding via
+        the shared staging decision tree (``prefetcher.stage_leaf``): an
+        array the prefetcher already put with the right sharding passes
+        through untouched — the overlap path must add zero work here (and
+        must NOT round-trip device arrays through numpy)."""
+        v = getattr(v, "_get", lambda: v)()
+        if self._batch_shard is None:
+            return v
+        from ..gluon.data.prefetcher import stage_leaf
+
+        return stage_leaf(v, self._batch_shard)
+
     def __call__(self, x, y):
-        import jax
-        import numpy as _np
         from jax import random as jr
 
-        x = getattr(x, "_get", lambda: x)()
-        y = getattr(y, "_get", lambda: y)()
-        if self._batch_shard is not None:
-            x = jax.device_put(_np.asarray(x), self._batch_shard)
-            y = jax.device_put(_np.asarray(y), self._batch_shard)
+        x = self._stage_batch(x)
+        y = self._stage_batch(y)
         rng = jr.PRNGKey(self._rng_seed)
         self._rng_seed += 1
         # telemetry compile tracer: an unseen batch signature means this
@@ -384,6 +392,41 @@ class TrainStep:
                 _t.perf_counter() - t0,
                 "new_step" if len(self._seen_sigs) == 1 else "new_shape")
         return loss
+
+    def run(self, batches, steps=None, prefetch=None):
+        """Drive the fused step over an iterator of ``(x, y)`` batches with
+        device prefetch: a background thread keeps the next
+        ``MXNET_PREFETCH_BUFFER`` batches in flight (non-blocking
+        ``device_put`` with this step's batch sharding), so host-side input
+        staging overlaps the previous step's compute.  ``prefetch``
+        overrides the depth (0 = serial staging).  Returns the per-step
+        losses (device scalars — only the last is synced).
+
+        With ``steps=N`` the loop never pops past batch N, but the
+        background pipeline has up to ``depth`` more batches staged which
+        ``close()`` drops — callers chunking ONE shared iterator across
+        several ``run`` calls should pass ``prefetch=0`` (or slice the
+        batch list) so no batch is consumed and discarded."""
+        from ..gluon.data.prefetcher import PrefetchIterator
+
+        it = PrefetchIterator(iter(batches), depth=prefetch,
+                              sharding=self._batch_shard)
+        losses = []
+        try:
+            while steps is None or len(losses) < steps:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                x, y = batch[0], batch[1]
+                losses.append(self(x, y))
+        finally:
+            it.close()
+        if losses:
+            import numpy as _np
+
+            _np.asarray(losses[-1])  # surface step errors inside run()
+        return losses
 
     def write_back(self):
         """Copy trained parameter values back into the Gluon net."""

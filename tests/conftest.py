@@ -60,3 +60,29 @@ def _seed_everything():
 
     mx.random.seed(0)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _isolate_leaked_globals():
+    """Every test starts from the same process-wide gluon/parallel state.
+
+    Two globals leak across tests and made tier-1 order-dependent:
+
+    - the gluon auto-name counter (``block._NAME_SCOPE.counters``): a test
+      whose net gets ``dense9``/``dense10`` sees ``sorted(param names)``
+      diverge from structural order — whether that digit boundary is
+      straddled depended on how many layers EARLIER tests created (the
+      ``test_train_step_fsdp_mesh_matches_single_device`` flake);
+    - the session default mesh (``parallel.mesh._DEFAULT``), set as a side
+      effect by any dist-kvstore test that touches collectives.
+
+    Resetting both per test makes name assignment and mesh discovery a
+    function of the test alone, not of the suite prefix that ran before.
+    """
+    from mxnet_tpu.gluon import block as _block
+    from mxnet_tpu.parallel import mesh as _mesh
+
+    _block._NAME_SCOPE.counters.clear()
+    del _block._NAME_SCOPE.scope_stack[:]
+    _mesh._DEFAULT = None
+    yield

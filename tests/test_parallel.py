@@ -114,10 +114,15 @@ def test_train_step_fsdp_mesh_matches_single_device():
                       mesh=mesh, param_sharding="fsdp",
                       batch_axes=("dp", "fsdp"))
     net2 = _tiny_net()
-    # same initial params
-    for (k, v), (k2, p2) in zip(sorted(stepm.params.items()),
-                                sorted(net2.collect_params().items())):
-        p2.data()._set(v)
+    # same initial params — paired STRUCTURALLY (collect_params insertion
+    # order), not by sorted global name: gluon's process-wide name counter
+    # means a net whose layers straddle a digit boundary (dense9/dense10)
+    # sorts out of structural order, and the pairing silently crosses
+    # layers (the old order-dependent flake: whether the boundary was
+    # straddled depended on how many layers earlier tests had created)
+    for (k, _), (k2, p2) in zip(net.collect_params().items(),
+                                net2.collect_params().items()):
+        p2.data()._set(stepm.params[k])
     steps = TrainStep(net2, _ce, optimizer="sgd",
                       optimizer_params={"learning_rate": 0.1})
     x = np.random.randn(8, 8).astype("float32")
