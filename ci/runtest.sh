@@ -9,6 +9,9 @@
 #               + kill-worker recovery integration
 #   chaos       fault-injection suite (checkpoint corruption, worker
 #               death, retry exhaustion) + ambient-MXNET_FAULT_SPEC smoke
+#               + preemption/watchdog lifecycle smoke (SIGTERM mid-run ->
+#               published checkpoint -> bit-identical resume; wedged step
+#               -> stack-dump diagnosis + abort)
 #   telemetry   runtime-telemetry smoke (train loop with telemetry +
 #               profiler on; Prometheus/snapshot/compile-event checks)
 #               + the telemetry unit suite
@@ -47,7 +50,12 @@ case "$LANE" in
     #    and the supervised loop absorbs the injected checkpoint failure
     JAX_PLATFORMS=cpu MXNET_FAULT_SPEC="checkpoint.write:fail:1" \
       python ci/chaos_smoke.py
-    # 2) the fault suite incl. slow scenarios (real SIGKILL of a worker).
+    # 2) lifecycle smoke against REAL child processes: SIGTERM mid-run
+    #    must publish a checkpoint within the grace period and the
+    #    resume must be bit-identical; a wedged step must trip the
+    #    watchdog (diagnosis file + stall counter + abort status)
+    JAX_PLATFORMS=cpu python ci/preemption_smoke.py
+    # 3) the fault suite incl. slow scenarios (real SIGKILL of a worker).
     #    The unit lane also runs this file; the repeat is deliberate —
     #    the chaos stage must stay green/triagable on its own (ISSUE 2)
     #    and is cheap (~20s).  test_checkpoint.py is NOT repeated.

@@ -76,6 +76,7 @@ from . import numpy_extension as npx
 from . import env
 from . import fault
 from . import telemetry
+from . import lifecycle
 
 env.apply_env()
 from . import parallel

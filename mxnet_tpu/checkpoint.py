@@ -74,6 +74,9 @@ _UNSUMMED = ("meta.json", "COMMITTED")
 
 
 def _fsync_file(path):
+    # a long synchronous save (the preemption stop path in particular)
+    # is progress, not a stall: beat the watchdog per durability step
+    telemetry.heartbeat()
     fault.check("checkpoint.fsync")
     fd = os.open(path, os.O_RDONLY)
     try:
@@ -275,6 +278,7 @@ class CheckpointManager:
         (SPMD peers must enqueue collectives in one program order), so
         the async path barriers on the CALLER's thread instead."""
         final = self._step_dir(step)
+        telemetry.heartbeat()   # a save is progress, not a stall
         try:
             if primary:
                 tmp = tempfile.mkdtemp(prefix=f"{_TMP_PREFIX}{step}_",
@@ -282,6 +286,7 @@ class CheckpointManager:
                 try:
                     fault.check("checkpoint.write")
                     write_payloads(tmp)
+                    telemetry.heartbeat()
                     meta = {"step": int(step), "time": time.time()}
                     if extra:
                         meta["extra"] = extra
@@ -322,8 +327,17 @@ class CheckpointManager:
                 self._barrier()
         return final
 
-    def save(self, step, net=None, trainer=None, extra=None, async_=None):
+    def save(self, step, net=None, trainer=None, extra=None, async_=None,
+             train_state=None):
         """Publish checkpoint `step` atomically; returns its directory.
+
+        ``train_state`` (a JSON-able dict, normally from
+        ``lifecycle.capture_train_state``) is written as
+        ``train_state.json`` — sha256-summed like every payload file —
+        and read back with :meth:`read_train_state`.  It carries what a
+        bit-identical resume needs beyond weights/optimizer state:
+        DataLoader/sampler position, the global RNG state, loss-scaler
+        counters, and step counters.
 
         ``async_=True`` (default from ``MXNET_CHECKPOINT_ASYNC``) makes
         only the device→host snapshot block the caller: file writes,
@@ -352,12 +366,20 @@ class CheckpointManager:
         primary = jax.process_index() == 0
         final = self._step_dir(step)
         t0 = time.perf_counter()
+        # serialize NOW in both paths: train_state is host data, and the
+        # caller may mutate its dicts (sampler epoch, RNG) right after
+        ts_blob = None if train_state is None else \
+            json.dumps(train_state).encode()
         if not async_:
             def write_payloads(tmp):
                 if net is not None:
                     net.save_parameters(os.path.join(tmp, "model.params"))
                 if trainer is not None:
                     trainer.save_states(os.path.join(tmp, "trainer.states"))
+                if ts_blob is not None:
+                    with open(os.path.join(tmp, "train_state.json"),
+                              "wb") as f:
+                        f.write(ts_blob)
 
             # a save inside an open telemetry step is its own phase; the
             # phase must close even when the barrier fails, or the
@@ -380,6 +402,12 @@ class CheckpointManager:
             try:
                 writers = self._snapshot_payloads(net, trainer) if primary \
                     else {}
+                if primary and ts_blob is not None:
+                    def write_ts(path, _blob=ts_blob):
+                        with open(path, "wb") as f:
+                            f.write(_blob)
+
+                    writers["train_state.json"] = write_ts
             finally:
                 # ALL processes must reach the barrier even when the
                 # primary's snapshot raises (same invariant as the sync
@@ -546,6 +574,16 @@ class CheckpointManager:
         with open(os.path.join(self._step_dir(step), "meta.json")) as f:
             return json.load(f)
 
+    def read_train_state(self, step):
+        """The ``train_state`` dict saved with ``step`` (None when the
+        checkpoint predates exact-resume or none was passed).  Feed it to
+        ``lifecycle.restore_train_state`` after ``restore()``."""
+        path = os.path.join(self._step_dir(step), "train_state.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
     def _gc(self):
         steps = self.all_steps()
         for s in steps[:-self.max_to_keep] if self.max_to_keep else []:
@@ -582,8 +620,16 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
     - Restart telemetry always reaches a logger — the module logger when
       ``logger`` is None — so silent restart loops show up in production
       logs.
+    - A ``lifecycle.GracefulExit`` from train_fn is a PREEMPTED-CLEAN
+      exit, not a failure: the final checkpoint is already published, so
+      the supervisor joins any in-flight async write, does NOT count a
+      restart, and re-raises — the caller translates it to
+      ``sys.exit(lifecycle.EXIT_PREEMPTED)`` and the external scheduler
+      relaunches the job, which resumes bit-identically.
 
     Returns train_fn's result."""
+    from .lifecycle import GracefulExit
+
     log = logger or _LOGGER
     if backoff_ms is None:
         backoff_ms = fault.backoff_ms()
@@ -613,6 +659,17 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
                 join(raise_=jax.process_count() == 1)
             return result
         except KeyboardInterrupt:
+            raise
+        except GracefulExit as e:
+            # preempted-clean: the loop honored a stop and published its
+            # final checkpoint — never counted against the restart budget
+            join = getattr(manager, "_join_pending", None)
+            if join is not None:
+                import jax
+
+                join(raise_=jax.process_count() == 1)
+            log.info("preempted-clean exit (%s); latest valid step %s",
+                     e, progress())
             raise
         except Exception as e:
             if should_retry is not None and not should_retry(e):

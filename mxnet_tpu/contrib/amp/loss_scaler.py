@@ -27,12 +27,20 @@ class LossScaler:
     def has_overflow(self, params):
         """True if any gradient of ``params`` is non-finite.
 
+        One device-side reduction, ONE blocking host sync: the per-grad
+        non-finite counts are summed as lazily-dispatched device ops and
+        only the final scalar crosses to the host — the previous
+        per-param ``bool(jnp.isfinite(v).all())`` loop paid K blocking
+        round-trips on every AMP step.  The verdict is identical: the
+        total is > 0 iff any gradient had any non-finite element.
+
         In a multi-process job the verdict is agreed across all processes
         (logical-or via a host allreduce): a process-local skip would desync
         the replicas' weights and loss scales."""
+        import jax
         import jax.numpy as jnp
 
-        overflow = False
+        total = None
         for p in params:
             if p.grad_req == "null" or p._data is None:
                 continue
@@ -40,19 +48,28 @@ class LossScaler:
                 v = g._get()
                 if not jnp.issubdtype(v.dtype, jnp.floating):
                     continue
-                if not bool(jnp.isfinite(v).all()):
-                    overflow = True
-                    break
-            if overflow:
-                break
-        import jax
-
+                # count (not any()): sums of non-negative counts keep the
+                # > 0 verdict exact under float32 accumulation
+                bad = jnp.sum(~jnp.isfinite(v)).astype(jnp.float32)
+                total = bad if total is None else total + bad
+        if total is None:
+            return False
         if jax.process_count() > 1:
             from ...parallel.collectives import allreduce_hosts
 
-            overflow = bool(np.asarray(
-                allreduce_hosts(jnp.asarray(overflow, jnp.float32))) > 0)
-        return overflow
+            total = allreduce_hosts(total)
+        return bool(np.asarray(total) > 0)
+
+    def state_dict(self):
+        """Scale + clean-step counter for exact resume
+        (lifecycle.capture_train_state): without it a resumed fp16 run
+        restarts at init_scale and the first steps' updates diverge."""
+        return {"loss_scale": float(self.loss_scale),
+                "unskipped": int(self._unskipped)}
+
+    def load_state_dict(self, state):
+        self.loss_scale = float(state["loss_scale"])
+        self._unskipped = int(state["unskipped"])
 
     def update_scale(self, overflow):
         """Adjust the scale after a step; returns True if the step should be

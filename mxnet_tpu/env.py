@@ -66,6 +66,26 @@ Wired vars (read at ``import mxnet_tpu``):
 - ``MXNET_CHECKPOINT_ASYNC``: default for ``CheckpointManager.save``'s
   ``async_`` parameter (0/unset = synchronous saves; explicit
   ``async_=`` always wins).
+- ``MXNET_WATCHDOG_TIMEOUT_S``: per-step stall deadline in seconds for the
+  lifecycle watchdog (default 0 = off; ``env.apply_env`` starts the
+  watchdog when set — see :mod:`mxnet_tpu.lifecycle`).
+- ``MXNET_WATCHDOG_ABORT``: whether a tripped watchdog exits the process
+  (status ``lifecycle.EXIT_STALLED``) after writing the diagnosis file
+  (default 1; 0 = diagnose only).
+- ``MXNET_WATCHDOG_DIR``: directory for watchdog stall-diagnosis files
+  (default the working directory).
+- ``MXNET_GRACE_PERIOD_S``: seconds between a preemption signal and a
+  forced exit when the training loop has not honored the stop (default
+  0 = no forced exit; match the scheduler's SIGTERM→SIGKILL grace).
+- ``MXNET_PREEMPTION_CHECKPOINT``: publish a final synchronous checkpoint
+  on a graceful preemption stop (default 1).
+- ``MXNET_LIFECYCLE_SIGNALS``: ``parallel.distributed.init`` installs the
+  graceful SIGTERM/SIGINT handlers for multi-process jobs (default 1;
+  0 = the embedder owns signal dispositions).
+- ``MXNET_STOP_SYNC_EVERY``: issue the multi-process stop-agreement
+  collective every N-th ``lifecycle.check_stop()`` call (default 1;
+  larger N amortizes the per-step scalar all-reduce, stop latency grows
+  to at most N steps).
 
 Accepted-but-subsumed (XLA owns the concern; reads return the default and
 ``describe()`` says why):
@@ -152,6 +172,46 @@ def checkpoint_async_default():
     return get_bool("MXNET_CHECKPOINT_ASYNC", False)
 
 
+def get_float(name, default=0.0):
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"{name}={v!r} is not a number; using {default}",
+                      stacklevel=2)
+        return default
+
+
+def watchdog_timeout_s():
+    """Per-step stall deadline for the lifecycle watchdog
+    (MXNET_WATCHDOG_TIMEOUT_S, default 0 = watchdog off)."""
+    return max(0.0, get_float("MXNET_WATCHDOG_TIMEOUT_S", 0.0))
+
+
+def grace_period_s():
+    """Signal→forced-exit deadline for graceful preemption
+    (MXNET_GRACE_PERIOD_S, default 0 = no forced exit)."""
+    return max(0.0, get_float("MXNET_GRACE_PERIOD_S", 0.0))
+
+
+def preemption_checkpoint_default():
+    """Whether a graceful preemption stop publishes a final synchronous
+    checkpoint (MXNET_PREEMPTION_CHECKPOINT, default on)."""
+    return get_bool("MXNET_PREEMPTION_CHECKPOINT", True)
+
+
+def stop_sync_every():
+    """Issue the multi-process stop-agreement collective every N-th
+    check_stop() call (MXNET_STOP_SYNC_EVERY, default 1 = every step
+    boundary; raise to amortize on very short steps — stop latency grows
+    to at most N steps)."""
+    return max(1, get_int("MXNET_STOP_SYNC_EVERY", 1))
+
+
 def describe():
     """One line per known var: current value and what it maps to."""
     lines = []
@@ -195,6 +255,20 @@ def describe():
          "(default 32; 0 = per-key collectives; parallel/bucketing.py)"),
         ("MXNET_CHECKPOINT_ASYNC", "default for CheckpointManager.save "
          "async_ (unset/0 = synchronous saves)"),
+        ("MXNET_WATCHDOG_TIMEOUT_S", "per-step stall deadline in seconds "
+         "(default 0 = watchdog off; mxnet_tpu.lifecycle)"),
+        ("MXNET_WATCHDOG_ABORT", "tripped watchdog exits the process after "
+         "the diagnosis dump (default 1; 0 = diagnose only)"),
+        ("MXNET_WATCHDOG_DIR", "directory for watchdog stall-diagnosis "
+         "files (default cwd)"),
+        ("MXNET_GRACE_PERIOD_S", "preemption-signal → forced-exit deadline "
+         "(default 0 = none; match the scheduler's SIGTERM grace)"),
+        ("MXNET_PREEMPTION_CHECKPOINT", "final synchronous checkpoint on a "
+         "graceful preemption stop (default 1)"),
+        ("MXNET_LIFECYCLE_SIGNALS", "distributed.init installs graceful "
+         "SIGTERM/SIGINT handlers (default 1)"),
+        ("MXNET_STOP_SYNC_EVERY", "stop-agreement collective every N-th "
+         "check_stop (default 1; N steps max stop latency)"),
     ]
     for name, what in wired:
         lines.append(f"{name}={os.environ.get(name, '<unset>')} — {what}")
@@ -216,6 +290,10 @@ def apply_env():
 
         profiler.set_config(profile_all=True)
         profiler.start()
+    if watchdog_timeout_s() > 0:
+        from . import lifecycle
+
+        lifecycle.start_watchdog()
     port = get_int("MXNET_TELEMETRY_PORT", 0)
     if port > 0:
         from . import telemetry

@@ -23,6 +23,10 @@ Seams (each named check-point is called on the real code path):
 ``kvstore.pull``            KVStore.pull entry (host-side transport seam)
 ``collectives.allreduce``   host-value cross-process collectives
 ``distributed.init``        jax.distributed coordinator rendezvous
+``lifecycle.sigterm``       step-boundary stop poll (an armed fault is
+                            treated as a delivered preemption signal)
+``watchdog.stall``          watchdog poll (an armed fault is treated as an
+                            expired step deadline)
 ==========================  =================================================
 
 Arming faults:
@@ -65,7 +69,8 @@ __all__ = ["SEAMS", "check", "guard", "inject", "stats", "reset_stats",
 
 SEAMS = ("checkpoint.write", "checkpoint.fsync", "checkpoint.publish",
          "dataloader.worker", "kvstore.push", "kvstore.pull",
-         "collectives.allreduce", "distributed.init")
+         "collectives.allreduce", "distributed.init",
+         "lifecycle.sigterm", "watchdog.stall")
 
 _LOGGER = logging.getLogger(__name__)
 _LOCK = threading.Lock()

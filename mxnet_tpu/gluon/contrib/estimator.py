@@ -4,6 +4,7 @@ from __future__ import annotations
 import time
 
 from ... import autograd
+from ... import lifecycle as _lifecycle
 from ... import metric as metric_mod
 from ... import telemetry as _telemetry
 from ...base import MXNetError
@@ -57,14 +58,35 @@ class Estimator:
                               (train_metrics or ["accuracy"])]
         self.trainer = trainer
         self.context = context
+        # batches trained across fit() calls — the Estimator step counter
+        # lifecycle.capture_train_state records; restore_train_state's
+        # returned step is assigned back here on resume
+        self.global_step = 0
 
     def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
-            batch_size=None, telemetry=False):
+            batch_size=None, telemetry=False, checkpoint_manager=None):
         """``telemetry=True`` opens a telemetry timeline step per batch and
         attributes it to phases: ``data`` (iterator wait),
         ``forward_backward``, and ``optimizer`` (``trainer.step`` — which
         itself splits out ``collectives`` when the Trainer was built with
-        ``telemetry=True``).  See :mod:`mxnet_tpu.telemetry`."""
+        ``telemetry=True``).  See :mod:`mxnet_tpu.telemetry`.
+
+        Preemption contract (:mod:`mxnet_tpu.lifecycle`): every batch
+        boundary polls ``lifecycle.check_stop()`` (agreed across SPMD
+        peers).  On a stop, a final SYNCHRONOUS checkpoint — net, trainer,
+        and the exact-resume train_state (DataLoader position, RNG, step
+        counter) — is published through ``checkpoint_manager`` (when one
+        is passed and ``MXNET_PREEMPTION_CHECKPOINT`` allows), then
+        ``lifecycle.GracefulExit`` is raised; ``run_with_recovery`` does
+        not count it against the restart budget.
+
+        The preemption checkpoint is numbered by ``global_step`` (the
+        BATCH counter).  Checkpoint step numbers must be monotonic
+        within one directory, so give fit its own manager/directory —
+        do not mix it with a manager you save epoch-numbered
+        checkpoints into, or an epoch save (small number) published
+        after a batch-numbered preemption save (large number) makes
+        ``latest_valid_step()`` resume the stale preemption point."""
         if self.trainer is None:
             raise MXNetError("Estimator needs a trainer")
         history = []
@@ -93,10 +115,13 @@ class Estimator:
                 with _telemetry.maybe_phase(telemetry, "optimizer"):
                     self.trainer.step(bs)
                 nsamples += bs
+                self.global_step += 1
                 for m in self.train_metrics:
                     m.update([label], [out])
                 if telemetry:
                     _telemetry.step_end()
+                if _lifecycle.check_stop():
+                    self._stop_gracefully(train_data, checkpoint_manager)
             elapsed = time.time() - tic
             stats = {name: val for name, val in
                      (m.get() for m in self.train_metrics)}
@@ -104,3 +129,20 @@ class Estimator:
             stats["epoch"] = epoch
             history.append(stats)
         return history
+
+    def _stop_gracefully(self, train_data, checkpoint_manager):
+        """Honor an agreed preemption stop at a batch boundary: publish
+        the final synchronous checkpoint (weights + optimizer + exact-
+        resume train_state) and raise GracefulExit."""
+        step = self.global_step
+        if checkpoint_manager is not None:
+            train_state = _lifecycle.capture_train_state(
+                step=step,
+                dataloader=train_data if hasattr(train_data, "state_dict")
+                else None,
+                trainer=self.trainer)
+            _lifecycle.publish_final_checkpoint(
+                checkpoint_manager, step, self.net, self.trainer,
+                train_state=train_state)
+        raise _lifecycle.GracefulExit(
+            _lifecycle.stop_reason() or "stop requested", step=step)

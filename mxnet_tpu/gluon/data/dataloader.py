@@ -170,9 +170,93 @@ class DataLoader:
         self._proc_pool_method = None   # expensive: pay startup once)
         self._pool_finalizer = None
         self._active_stops = set()      # stop events of live epoch iters
+        # exact-resume position (lifecycle.capture_train_state): epoch of
+        # the iterator currently live, batches the CONSUMER received from
+        # it, the batch-sampler state as of that epoch's start, and a
+        # pending resume point applied by the next __iter__
+        self._epoch = -1
+        self._batches_served = 0
+        self._epoch_start_state = None
+        self._skip_next = 0
+        self._resume = None
 
     def __len__(self):
         return len(self._batch_sampler)
+
+    def state_dict(self):
+        """Resume point for :meth:`load_state_dict`: the live epoch, how
+        many batches the consumer already received from it, and the
+        batch-sampler state as of the epoch start (shuffle seed + epoch
+        + rollover carry).  Capture at a step boundary; state tracking
+        assumes ONE active iterator per loader (the training loop's)."""
+        if self._resume is not None:
+            # captured before the armed resume point was consumed by an
+            # __iter__: the position is still the armed one
+            return dict(self._resume)
+        return {"epoch": max(self._epoch, 0),
+                "batch": self._batches_served,
+                "sampler": self._epoch_start_state}
+
+    def load_state_dict(self, state):
+        """Arm the next ``__iter__`` to resume at ``state``: the sampler
+        regenerates the recorded epoch's index sequence and the first
+        ``state["batch"]`` batches are skipped DECODE-FREE — only index
+        lists are consumed, ``dataset[i]`` is never called for them —
+        so fast-forwarding a multi-epoch position costs microseconds,
+        not an epoch of decode."""
+        self._resume = dict(state or {})
+
+    def _begin_epoch(self):
+        """Apply epoch numbering (and any armed resume point) before the
+        underlying iterator is built; returns nothing, sets counters."""
+        resume, self._resume = self._resume, None
+        if resume is not None:
+            self._epoch = int(resume.get("epoch") or 0)
+            self._skip_next = int(resume.get("batch") or 0)
+            sd = resume.get("sampler")
+            if sd is not None and hasattr(self._batch_sampler,
+                                          "load_state_dict"):
+                self._batch_sampler.load_state_dict(sd)
+            elif self._skip_next:
+                # no captured sampler state, OR state that the rebuilt
+                # sampler cannot load: we can fast-forward the COUNT but
+                # not replay the order — if the sampler reshuffles,
+                # skipped batches come from a DIFFERENT permutation and
+                # data is silently repeated or lost.  Exact resume needs
+                # state_dict AND load_state_dict (and ideally set_epoch)
+                # on the batch sampler.
+                import warnings
+
+                warnings.warn(
+                    "DataLoader resume: the batch sampler "
+                    + ("recorded no state (no state_dict())"
+                       if sd is None else
+                       "cannot restore its recorded state "
+                       "(no load_state_dict())")
+                    + f"; skipping {self._skip_next} batches of a "
+                    "potentially DIFFERENT order — the resumed sequence "
+                    "is only bit-identical for deterministic samplers",
+                    stacklevel=3)
+        else:
+            self._epoch += 1
+            self._skip_next = 0
+        se = getattr(self._batch_sampler, "set_epoch", None)
+        if se is not None:
+            se(self._epoch)
+        self._epoch_start_state = self._batch_sampler.state_dict() \
+            if hasattr(self._batch_sampler, "state_dict") else None
+        # skipped batches were already consumed by the killed run
+        self._batches_served = self._skip_next
+
+    def _epoch_batches(self):
+        """Index-batches of the current epoch, with the resume skip
+        applied: the fast-forward drains index lists only — decode-free."""
+        it = iter(self._batch_sampler)
+        skip, self._skip_next = self._skip_next, 0
+        for _ in range(skip):
+            if next(it, None) is None:
+                return
+        yield from it
 
     def __iter__(self):
         # batch-wait attribution: time from the consumer asking for the
@@ -180,6 +264,7 @@ class DataLoader:
         # the stall the training loop actually feels, the "data wait"
         # answer to "why was this step slow?"  The device prefetcher sits
         # INSIDE this measurement so the histogram shows the shrink.
+        self._begin_epoch()
         it = self._iter_impl()
         pf = None
         if self._prefetch_to_device:
@@ -197,6 +282,7 @@ class DataLoader:
                     return
                 _BATCH_WAIT.observe(_time.perf_counter() - t0)
                 _BATCHES_TOTAL.inc()
+                self._batches_served += 1
                 yield batch
         finally:
             # runs on exhaustion, break, and generator GC alike — a
@@ -206,7 +292,7 @@ class DataLoader:
 
     def _iter_impl(self):
         if self._num_workers == 0:
-            for batch in self._batch_sampler:
+            for batch in self._epoch_batches():
                 yield self._batchify_fn([self._dataset[i] for i in batch])
             return
         if self._thread_pool:
@@ -257,7 +343,7 @@ class DataLoader:
         self._active_stops.add(stop)
 
         def gated():
-            for b in self._batch_sampler:
+            for b in self._epoch_batches():
                 while not sem.acquire(timeout=0.1):
                     if stop.is_set():
                         return
@@ -403,7 +489,7 @@ class DataLoader:
 
     def _threaded_iter(self):
         pool = ThreadPoolExecutor(max_workers=self._num_workers)
-        batches = list(self._batch_sampler)
+        batches = list(self._epoch_batches())
 
         def load(batch):
             return self._batchify_fn([self._dataset[i] for i in batch])

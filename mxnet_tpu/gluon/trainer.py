@@ -97,6 +97,19 @@ class Trainer:
         self._kv_initialized = True
 
     @property
+    def step_count(self):
+        """Optimizer updates applied so far (``optimizer.num_update``).
+        Persisted through save_states/load_states via the pickled
+        optimizer; lifecycle.capture_train_state records it as the
+        exact-resume cross-check against the supervisor's step number.
+        Under ``update_on_kvstore`` the store's (pickle-copied) optimizer
+        is the one that advances — the local one never counts there."""
+        if self._update_on_kvstore and self._kvstore is not None and \
+                self._kvstore._optimizer is not None:
+            return self._kvstore._optimizer.num_update
+        return self._optimizer.num_update
+
+    @property
     def learning_rate(self):
         return self._optimizer.lr if self._optimizer.lr_scheduler is None else \
             self._optimizer.lr_scheduler(self._optimizer.num_update)

@@ -1,0 +1,581 @@
+"""Preemption-safe training lifecycle: graceful shutdown, exact-resume
+training state, and a stall watchdog.
+
+Production TPU jobs run on preemptible pods: the scheduler delivers
+SIGTERM, waits a grace period, then SIGKILLs.  The recovery story built
+so far (fault seams, sha256 checkpoints, ``run_with_recovery``) resumes
+*approximately* — a restart replays or skips data because iterator
+position, shuffle RNG, loss-scaler state and step counters were not
+checkpointed, and a hung SPMD collective (one peer re-issues, the mesh
+deadlocks — see parallel/collectives.py) stalls the job silently.  This
+module closes the three lifecycle gaps so a preempted or stalled job
+costs bounded wall-time and resumes bit-identically:
+
+- **Graceful preemption** — ``install_signal_handlers()`` (SIGTERM/
+  SIGINT) or programmatic ``request_stop(reason)`` set a stop flag that
+  training loops (``Estimator.fit``, ``TrainStep.run``, and any
+  ``run_with_recovery`` train_fn) poll at step boundaries via
+  ``check_stop()``.  In a multi-process job the flag is *agreed* through
+  a one-scalar all-reduce so every SPMD peer exits at the same step — a
+  unilateral exit would strand the peers in their next collective.  The
+  loop then publishes a final synchronous checkpoint (unless
+  ``MXNET_PREEMPTION_CHECKPOINT=0``) and raises :class:`GracefulExit`,
+  which ``run_with_recovery`` re-raises WITHOUT counting it against the
+  restart budget; callers exit with :data:`EXIT_PREEMPTED` so the
+  supervisor can tell "preempted clean" from "crashed".  A configured
+  ``MXNET_GRACE_PERIOD_S`` arms a deadline: if the loop has not honored
+  the stop when it expires, the process force-exits (the scheduler's
+  SIGKILL would land mid-write otherwise).
+- **Exact-resume training state** — ``capture_train_state()`` bundles
+  the DataLoader/sampler position (epoch, batch index, shuffle seed —
+  restored with a decode-free fast-forward), the ``mx.random`` global
+  RNG state, ``LossScaler`` scale/skip counters, and Estimator/Trainer
+  step counters; ``CheckpointManager.save(..., train_state=...)``
+  persists it (sha256-summed like every payload) and
+  ``restore_train_state()`` re-applies it, making a resumed run's batch
+  sequence and loss trajectory bit-identical to an uninterrupted run.
+- **Stall watchdog** — :class:`Watchdog` is a daemon thread fed by the
+  telemetry step heartbeat (``telemetry.heartbeat()``, beaten by
+  ``step_begin``/``step_end`` and by every ``check_stop()``).  When no
+  heartbeat lands within ``MXNET_WATCHDOG_TIMEOUT_S`` (default off) it
+  dumps all-thread stacks + a telemetry snapshot to a diagnosis file,
+  increments ``mxnet_watchdog_stalls_total``, and (configurably,
+  ``MXNET_WATCHDOG_ABORT``) aborts the process so the external
+  supervisor restarts from the last valid checkpoint instead of hanging
+  until an external timeout.
+
+Chaos seams: arming ``lifecycle.sigterm`` (``MXNET_FAULT_SPEC`` or
+``fault.inject``) makes the next ``check_stop()`` behave as if a SIGTERM
+arrived; arming ``watchdog.stall`` makes the watchdog treat its next
+poll as an expired deadline — both paths are deterministically testable
+without real signals or real wall-clock stalls.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+
+from . import env as _env
+from . import fault
+from . import telemetry
+
+__all__ = ["GracefulExit", "EXIT_PREEMPTED", "EXIT_FORCED", "EXIT_STALLED",
+           "request_stop", "stop_requested", "stop_reason", "check_stop",
+           "coordinate_stops", "install_signal_handlers",
+           "uninstall_signal_handlers", "cancel_grace_deadline",
+           "publish_final_checkpoint",
+           "capture_train_state", "restore_train_state",
+           "Watchdog", "start_watchdog", "stop_watchdog", "reset"]
+
+_LOGGER = logging.getLogger(__name__)
+# REENTRANT: the signal handler runs ON the main thread between
+# bytecodes, so it can interrupt a critical section this module itself
+# holds (e.g. request_stop via the fault seam, or a second SIGTERM while
+# the first handler is still inside its locked section).  A plain Lock
+# would self-deadlock the process right when it is trying to stop; an
+# RLock re-acquires on the same thread, and every critical section here
+# is a simple dict update, so re-entry is benign.
+_LOCK = threading.RLock()
+
+# exit-status contract with the external supervisor (documented in the
+# README preemption flow): distinct codes so "preempted clean" is never
+# confused with "crashed" and never burns a restart budget
+EXIT_PREEMPTED = 43   # stop honored: final checkpoint published, clean exit
+EXIT_FORCED = 44      # MXNET_GRACE_PERIOD_S expired before the loop stopped
+EXIT_STALLED = 45     # watchdog abort: step deadline expired
+
+_STOP = {"requested": False, "reason": None, "time": None}
+# peer agreement for the stop flag: "enabled" turns the per-boundary
+# collective on, "calls" counts sync-eligible check_stop() calls so the
+# MXNET_STOP_SYNC_EVERY stride stays aligned across SPMD peers, and
+# "agreed" is the last COLLECTIVE verdict — the only thing a coordinated
+# loop may act on (a locally-set flag acted on off-cycle would exit one
+# rank without its peers and deadlock the mesh)
+_SYNC = {"enabled": False, "calls": 0, "agreed": False}
+_HANDLERS = {"installed": False, "prev": {}, "deliveries": 0}
+
+_STOPS_TOTAL = telemetry.counter(
+    "mxnet_lifecycle_stops_total", "stop requests (signals + programmatic)")
+_STOP_GAUGE = telemetry.gauge(
+    "mxnet_lifecycle_stop_requested", "1 while a stop is pending")
+_STALLS_TOTAL = telemetry.counter(
+    "mxnet_watchdog_stalls_total", "watchdog step-deadline expiries")
+
+
+class GracefulExit(Exception):
+    """Raised by a training loop that honored a preemption stop: the final
+    checkpoint (if enabled) is already published.  ``run_with_recovery``
+    re-raises it WITHOUT counting a restart; callers translate it to
+    ``sys.exit(EXIT_PREEMPTED)``."""
+
+    def __init__(self, reason="preempted", step=None):
+        self.reason = reason
+        self.step = step
+        at = f" at step {step}" if step is not None else ""
+        super().__init__(f"graceful preemption exit{at}: {reason}")
+        # constructing this exception IS the loop honoring the stop (the
+        # final checkpoint write already finished, or was skipped by the
+        # knob): disarm the grace-period force-exit so a caller that
+        # catches GracefulExit and lives on (notebook, embedder doing
+        # post-stop uploads) is not os._exit'd later for a stop that WAS
+        # honored.  A final save that wedges never reaches this line, so
+        # the deadline still bounds it.
+        cancel_grace_deadline()
+
+
+# --------------------------------------------------------------------------
+# stop flag + peer agreement
+# --------------------------------------------------------------------------
+def request_stop(reason="programmatic"):
+    """Ask the training loop to exit at the next step boundary.  Safe from
+    signal handlers and any thread; idempotent (first reason wins)."""
+    with _LOCK:
+        if _STOP["requested"]:
+            return
+        _STOP["requested"] = True
+        _STOP["reason"] = str(reason)
+        _STOP["time"] = time.time()
+    _STOPS_TOTAL.inc()
+    _STOP_GAUGE.set(1)
+    # every stop (signal or programmatic) gets the same wall-time bound:
+    # no-op when MXNET_GRACE_PERIOD_S is unset
+    _arm_grace_deadline()
+    _LOGGER.warning("stop requested (%s); training will exit at the next "
+                    "step boundary", reason)
+
+
+def stop_requested():
+    """True once a stop was requested locally (signal, programmatic, or
+    learned from a peer through ``check_stop``)."""
+    return _STOP["requested"]
+
+
+def stop_reason():
+    return _STOP["reason"]
+
+
+def coordinate_stops(enabled=True):
+    """Turn on per-step peer agreement: every ``check_stop()`` becomes a
+    one-scalar all-reduce in a multi-process job so all SPMD peers see
+    the stop at the SAME step.  Enabled automatically by
+    ``install_signal_handlers`` and ``parallel.distributed.init``;
+    single-process jobs never pay a collective either way."""
+    _SYNC["enabled"] = bool(enabled)
+
+
+def check_stop(sync=None):
+    """The step-boundary poll: returns True when the loop should stop.
+
+    Also beats the watchdog heartbeat — a loop that polls for preemption
+    is by definition not stalled.  ``sync`` overrides the peer-agreement
+    default (see :func:`coordinate_stops`).
+
+    Agreement contract: when peer coordination is on, the collective is
+    issued every ``MXNET_STOP_SYNC_EVERY``-th call (default 1 — agree at
+    every boundary; raise it to amortize the one-scalar all-reduce on
+    jobs with very short steps, at the cost of up to N steps of stop
+    latency).  The stride is counted per process, so EVERY process must
+    call ``check_stop`` once per step boundary, in the same program
+    order as its other collectives — per-rank iterators that yield
+    UNEQUAL step counts already desync SPMD training collectives, and
+    they desync this one the same way.
+
+    Chaos seam ``lifecycle.sigterm``: an armed fault here is treated as
+    a delivered preemption signal, so the whole graceful-shutdown path
+    is testable without a real SIGTERM."""
+    telemetry.heartbeat()
+    try:
+        fault.check("lifecycle.sigterm")
+    except Exception as e:
+        request_stop(f"fault-injected preemption ({e})")
+    local = _STOP["requested"]
+    if sync is None:
+        sync = _SYNC["enabled"]
+    if sync:
+        import jax
+
+        if jax.process_count() > 1:
+            # the stride must be a pure function of the per-process call
+            # COUNT (never of the local flag): a flag-conditional extra
+            # collective on one rank would desync the mesh.  Off-cycle
+            # calls return the last AGREED verdict — never the local
+            # flag, which would let a locally-signaled rank exit alone
+            # and strand its peers in their next collective.
+            with _LOCK:
+                _SYNC["calls"] += 1
+                due = _SYNC["calls"] % _env.stop_sync_every() == 0
+            if not due:
+                return _SYNC["agreed"]
+            from .parallel.collectives import allreduce_any
+
+            agreed = allreduce_any(local)
+            _SYNC["agreed"] = agreed
+            if agreed and not local:
+                request_stop("stop agreed from a peer process")
+            return agreed
+    return local
+
+
+# --------------------------------------------------------------------------
+# signal handlers + grace period
+# --------------------------------------------------------------------------
+def _on_signal(signum, frame):
+    import signal as _signal
+
+    with _LOCK:
+        _HANDLERS["deliveries"] += 1
+        repeat = _HANDLERS["deliveries"] > 1
+    if repeat:
+        # second delivery: the operator (or scheduler) wants out NOW —
+        # restore the previous disposition and re-deliver
+        uninstall_signal_handlers()
+        os.kill(os.getpid(), signum)
+        return
+    try:
+        name = _signal.Signals(signum).name
+    except ValueError:  # pragma: no cover
+        name = str(signum)
+    request_stop(f"signal {name}")   # arms the grace deadline too
+
+
+def _grace_expired(grace_s):
+    _LOGGER.critical(
+        "grace period of %.1fs expired before the training loop honored "
+        "the stop; force-exiting (status %d) so the scheduler's SIGKILL "
+        "does not land mid-checkpoint", grace_s, EXIT_FORCED)
+    logging.shutdown()
+    os._exit(EXIT_FORCED)
+
+
+_GRACE = {"timer": None}
+
+
+def _arm_grace_deadline():
+    grace = _env.grace_period_s()
+    if grace <= 0:
+        return
+    t = threading.Timer(grace, _grace_expired, args=(grace,))
+    t.daemon = True
+    with _LOCK:
+        _GRACE["timer"] = t
+    t.start()
+
+
+def cancel_grace_deadline():
+    """Disarm the force-exit deadline (idempotent).  Called automatically
+    when a GracefulExit is constructed — i.e. the stop was honored."""
+    with _LOCK:
+        t, _GRACE["timer"] = _GRACE["timer"], None
+    if t is not None:
+        t.cancel()
+
+
+def install_signal_handlers(signals=None):
+    """Install graceful-preemption handlers (default SIGTERM + SIGINT):
+    the first delivery requests a stop (and arms the
+    ``MXNET_GRACE_PERIOD_S`` force-exit deadline), a second delivery
+    restores the previous disposition and re-raises.  Also enables
+    multi-process stop agreement.  Idempotent; main thread only (signal
+    module contract) — a non-main-thread call is a logged no-op."""
+    import signal as _signal
+
+    sigs = tuple(signals or (_signal.SIGTERM, _signal.SIGINT))
+    with _LOCK:
+        if _HANDLERS["installed"]:
+            _SYNC["enabled"] = True
+            return True
+    try:
+        prev = {}
+        for s in sigs:
+            prev[s] = _signal.signal(s, _on_signal)
+    except ValueError:  # not the main thread
+        _LOGGER.warning("install_signal_handlers: not on the main thread; "
+                        "preemption signals will not be caught here")
+        return False
+    with _LOCK:
+        _HANDLERS["installed"] = True
+        _HANDLERS["prev"] = prev
+        _HANDLERS["deliveries"] = 0
+    _SYNC["enabled"] = True
+    return True
+
+
+def uninstall_signal_handlers():
+    """Restore the dispositions ``install_signal_handlers`` replaced."""
+    import signal as _signal
+
+    with _LOCK:
+        prev = _HANDLERS["prev"]
+        _HANDLERS["installed"] = False
+        _HANDLERS["prev"] = {}
+    for s, h in prev.items():
+        try:
+            _signal.signal(s, h)
+        except ValueError:  # pragma: no cover - non-main thread
+            pass
+
+
+def reset():
+    """Clear the stop flag + handler bookkeeping (test isolation)."""
+    uninstall_signal_handlers()
+    cancel_grace_deadline()
+    with _LOCK:
+        _STOP.update(requested=False, reason=None, time=None)
+        _HANDLERS["deliveries"] = 0
+        _SYNC.update(enabled=False, calls=0, agreed=False)
+    _STOP_GAUGE.set(0)
+
+
+# --------------------------------------------------------------------------
+# exact-resume training state
+# --------------------------------------------------------------------------
+def publish_final_checkpoint(manager, step, net=None, trainer=None,
+                             train_state=None):
+    """The stop-path save: a SYNCHRONOUS checkpoint at ``step`` (an async
+    write could still be staging when the grace period ends).  Honors
+    ``MXNET_PREEMPTION_CHECKPOINT`` (default on); returns the checkpoint
+    directory, or None when disabled."""
+    if not _env.preemption_checkpoint_default():
+        _LOGGER.warning("MXNET_PREEMPTION_CHECKPOINT=0: exiting WITHOUT a "
+                        "final checkpoint (step %s)", step)
+        return None
+    return manager.save(step, net, trainer, train_state=train_state,
+                        async_=False)
+
+
+def capture_train_state(step=None, dataloader=None, scaler=None,
+                        trainer=None, extra=None):
+    """Bundle everything beyond weights/optimizer-state that a
+    bit-identical resume needs, as a JSON-able dict for
+    ``CheckpointManager.save(..., train_state=...)``:
+
+    - ``mx.random`` global RNG state (always),
+    - DataLoader/sampler position — epoch, batches consumed, shuffle
+      seed (``dataloader.state_dict()``),
+    - LossScaler scale + clean-step counter (``scaler``),
+    - the Trainer's optimizer update count (``trainer`` — redundant with
+      the pickled optimizer in trainer.states, kept as a cross-check),
+    - caller extras (``extra``; must be JSON-able).
+
+    Capture at a step boundary, on the training thread (the RNG state is
+    thread-local), AFTER the step's checkpointable effects."""
+    from . import random as _random
+
+    st = {"format": 1, "rng": _random.get_state()}
+    if step is not None:
+        st["step"] = int(step)
+    if dataloader is not None:
+        sd = getattr(dataloader, "state_dict", None)
+        if sd is not None:
+            st["dataloader"] = sd()
+    if scaler is not None:
+        st["loss_scaler"] = scaler.state_dict()
+    if trainer is not None:
+        st["trainer"] = {"num_update": int(trainer.step_count)}
+    if extra:
+        st["extra"] = extra
+    return st
+
+
+def restore_train_state(state, dataloader=None, scaler=None):
+    """Re-apply a ``capture_train_state`` dict (RNG always; DataLoader /
+    LossScaler when passed).  Returns the recorded step (or None).  The
+    DataLoader fast-forwards decode-free on its next ``__iter__`` —
+    skipped batches never touch the dataset."""
+    if not state:
+        return None
+    from . import random as _random
+
+    if "rng" in state:
+        _random.set_state(state["rng"])
+    if dataloader is not None and state.get("dataloader") is not None:
+        dataloader.load_state_dict(state["dataloader"])
+    if scaler is not None and state.get("loss_scaler") is not None:
+        scaler.load_state_dict(state["loss_scaler"])
+    return state.get("step")
+
+
+# --------------------------------------------------------------------------
+# stall watchdog
+# --------------------------------------------------------------------------
+class Watchdog:
+    """Daemon thread enforcing a per-step deadline from the telemetry
+    heartbeat (``step_begin``/``step_end``/``check_stop`` all beat it).
+
+    On expiry: write a diagnosis file (all-thread stacks + telemetry
+    snapshot), bump ``mxnet_watchdog_stalls_total``, and — when ``abort``
+    (default ``MXNET_WATCHDOG_ABORT``, on) — exit the process with
+    :data:`EXIT_STALLED` so the external supervisor restarts from the
+    last valid checkpoint.  With ``abort=False`` it fires once per
+    distinct stall (re-arms only after the heartbeat advances).
+
+    A hung XLA collective cannot be un-wedged from inside the process
+    (the main thread is blocked in the runtime), which is why the abort
+    is a process exit, not an exception: restart-from-checkpoint is the
+    recovery path, the dump file is the diagnosis.
+
+    Two deliberate non-firing windows: (1) before the FIRST heartbeat
+    the job is still initializing — the first step's XLA compile can
+    dwarf the steady-state deadline — so a 10x startup allowance
+    applies; (2) while a stop is pending AND a ``MXNET_GRACE_PERIOD_S``
+    deadline is armed, that deadline owns termination (the final
+    synchronous checkpoint legitimately exceeds a per-step deadline on
+    large models), so the watchdog stands down instead of killing the
+    stop path it exists to protect — with no grace configured it keeps
+    enforcing, so a final save wedged on a dead peer still gets
+    diagnosed and aborted.
+
+    Chaos seam ``watchdog.stall``: an armed fault makes the next poll
+    behave as an expired deadline (even in the non-firing windows, so
+    tests stay deterministic)."""
+
+    def __init__(self, timeout_s=None, abort=None, dump_dir=None,
+                 poll_s=None, logger=None):
+        if timeout_s is None:
+            timeout_s = _env.watchdog_timeout_s()
+        self.timeout_s = float(timeout_s)
+        if abort is None:
+            abort = _env.get_bool("MXNET_WATCHDOG_ABORT", True)
+        self.abort = bool(abort)
+        self.dump_dir = dump_dir or _env.get_str("MXNET_WATCHDOG_DIR") or "."
+        self.poll_s = float(poll_s) if poll_s else \
+            max(0.05, min(self.timeout_s / 4.0, 1.0))
+        self.logger = logger or _LOGGER
+        self.last_dump = None
+        self.stall_count = 0
+        self._stop_evt = threading.Event()
+        self._thread = None
+        self._fired_base = None   # heartbeat value the last dump fired on
+
+    def start(self):
+        """Start polling; no-op (returns self) when the timeout is off."""
+        if self.timeout_s <= 0:
+            self.logger.info("watchdog disabled "
+                             "(MXNET_WATCHDOG_TIMEOUT_S unset/0)")
+            return self
+        if self._thread is not None:
+            return self
+        self._started = time.monotonic()
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mxnet-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        t = self._thread
+        self._thread = None
+        if t is not None:
+            self._stop_evt.set()
+            t.join(timeout=5)
+
+    # -- internals ---------------------------------------------------------
+    def _run(self):
+        while not self._stop_evt.wait(self.poll_s):
+            injected = None
+            try:
+                fault.check("watchdog.stall")
+            except Exception as e:
+                injected = e
+            last = telemetry.last_heartbeat()
+            base = last if last is not None else self._started
+            age = time.monotonic() - base
+            if injected is None:
+                if _STOP["requested"] and _GRACE["timer"] is not None:
+                    # stop path WITH a live grace deadline: that deadline
+                    # owns termination (the final sync save may
+                    # legitimately exceed a per-step timeout).  With no
+                    # grace configured the watchdog keeps enforcing —
+                    # otherwise a final save wedged on a dead peer's
+                    # barrier would hang forever with no diagnosis.
+                    continue
+                # startup allowance: no heartbeat yet = first step still
+                # compiling/warming, not a steady-state stall
+                limit = self.timeout_s if last is not None \
+                    else self.timeout_s * 10.0
+                if age <= limit:
+                    continue
+                if base == self._fired_base:
+                    continue   # same stall: already diagnosed, don't spam
+                # only a REAL fire consumes the per-stall one-shot: an
+                # injected (chaos) fire must not mask a genuine stall
+                # that wedges before the next heartbeat
+                self._fired_base = base
+            self._fire(age, injected)
+
+    def _fire(self, age, injected):
+        self.stall_count += 1
+        _STALLS_TOTAL.inc()
+        cause = f"injected fault ({injected})" if injected is not None \
+            else (f"no step heartbeat for {age:.1f}s "
+                  f"(deadline {self.timeout_s:.1f}s)")
+        try:
+            path = self._write_dump(age, cause)
+            self.last_dump = path
+        except Exception as e:  # the dump must never kill the watchdog
+            path = None
+            self.logger.error("watchdog: failed to write diagnosis "
+                              "file: %r", e)
+        self.logger.critical(
+            "watchdog stall: %s; diagnosis %s%s", cause, path,
+            f"; aborting with status {EXIT_STALLED}" if self.abort else "")
+        if self.abort:
+            logging.shutdown()
+            os._exit(EXIT_STALLED)
+
+    def _thread_stacks(self):
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        for tid, frame in sys._current_frames().items():
+            label = f"{names.get(tid, 'unknown')} (tid={tid})"
+            out[label] = traceback.format_stack(frame)
+        return out
+
+    def _write_dump(self, age, cause):
+        """One self-contained JSON diagnosis file per stall: what stalled
+        (all-thread stacks — the wedged collective/IO is in there) and
+        the job's state when it did (telemetry snapshot)."""
+        os.makedirs(self.dump_dir, exist_ok=True)
+        path = os.path.join(
+            self.dump_dir,
+            f"mxnet_watchdog_stall_{os.getpid()}_{self.stall_count}.json")
+        doc = {
+            "time": time.time(),
+            "pid": os.getpid(),
+            "cause": cause,
+            "timeout_s": self.timeout_s,
+            "heartbeat_age_s": age,
+            "stacks": self._thread_stacks(),
+            "telemetry": telemetry.snapshot(),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+        os.replace(tmp, path)
+        return path
+
+
+_WATCHDOG = None
+
+
+def start_watchdog(timeout_s=None, **kwargs):
+    """Start (or return) the process-wide watchdog.  Called from
+    ``env.apply_env`` when ``MXNET_WATCHDOG_TIMEOUT_S`` is set."""
+    global _WATCHDOG
+    with _LOCK:
+        if _WATCHDOG is None:
+            _WATCHDOG = Watchdog(timeout_s=timeout_s, **kwargs)
+    return _WATCHDOG.start()
+
+
+def stop_watchdog():
+    global _WATCHDOG
+    with _LOCK:
+        wd, _WATCHDOG = _WATCHDOG, None
+    if wd is not None:
+        wd.stop()

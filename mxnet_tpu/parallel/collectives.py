@@ -12,7 +12,7 @@ from functools import partial
 
 __all__ = ["psum", "pmean", "all_gather", "reduce_scatter", "ppermute",
            "all_to_all", "allreduce_hosts", "allreduce_hosts_quantized",
-           "allreduce_hosts_quantized_multi",
+           "allreduce_hosts_quantized_multi", "allreduce_any",
            "barrier", "shard_map"]
 
 
@@ -164,6 +164,28 @@ def allreduce_hosts(value, _testing_force=False):
         fault.guard("collectives.allreduce")
         return value
     return _combine_with_seam((value,), _sum_combine)
+
+
+def allreduce_any(flag, _testing_force=False):
+    """Cross-process logical-OR of a host-local bool in ONE collective —
+    the agreement primitive for coordinated preemption stops
+    (``lifecycle.check_stop``): every SPMD peer must call it at the same
+    step boundary, and every peer sees the same verdict, so they all
+    exit at the same step.  Single-process it is just the local flag
+    (seam-guarded like its siblings)."""
+    import jax
+
+    from .. import fault
+
+    if jax.process_count() == 1 and not _testing_force:
+        fault.guard("collectives.allreduce")
+        return bool(flag)
+    import numpy as np
+    import jax.numpy as jnp
+
+    out = allreduce_hosts(jnp.asarray(bool(flag), jnp.float32),
+                          _testing_force=_testing_force)
+    return bool(np.asarray(out) > 0)
 
 
 def barrier():

@@ -341,6 +341,7 @@ class TrainStep:
         donate_argnums = (0, 1, 2) if donate else ()
         self._step = jax.jit(step, donate_argnums=donate_argnums)
         self._rng_seed = 0
+        self.step_count = 0      # steps taken (lifecycle train_state)
         self._seen_sigs = set()  # telemetry: (x, y) avals already compiled
 
     @property
@@ -384,6 +385,7 @@ class TrainStep:
             t0 = _t.perf_counter()
         loss, self.train_params, self.rest_params, self.opt_state = self._step(
             self.train_params, self.rest_params, self.opt_state, rng, x, y)
+        self.step_count += 1
         if fresh:
             from .. import telemetry as _telemetry
 
@@ -406,7 +408,15 @@ class TrainStep:
         background pipeline has up to ``depth`` more batches staged which
         ``close()`` drops — callers chunking ONE shared iterator across
         several ``run`` calls should pass ``prefetch=0`` (or slice the
-        batch list) so no batch is consumed and discarded."""
+        batch list) so no batch is consumed and discarded.
+
+        Preemption contract (:mod:`mxnet_tpu.lifecycle`): every step
+        boundary polls ``lifecycle.check_stop()`` (agreed across SPMD
+        peers, and it beats the stall-watchdog heartbeat); on a stop the
+        loop returns the losses so far — the caller checks
+        ``lifecycle.stop_requested()``, publishes its final checkpoint,
+        and raises ``lifecycle.GracefulExit``."""
+        from .. import lifecycle as _lifecycle
         from ..gluon.data.prefetcher import PrefetchIterator
 
         it = PrefetchIterator(iter(batches), depth=prefetch,
@@ -414,6 +424,8 @@ class TrainStep:
         losses = []
         try:
             while steps is None or len(losses) < steps:
+                if _lifecycle.check_stop():
+                    break
                 try:
                     batch = next(it)
                 except StopIteration:

@@ -73,6 +73,18 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
         process_id=process_id,
         local_device_ids=local_device_ids)
     _STATE["initialized"] = True
+    # multi-process jobs are the preemptible case: turn on the per-step
+    # stop agreement (every peer must exit at the same step or the mesh
+    # deadlocks in its next collective) and catch the scheduler's
+    # SIGTERM so a preemption publishes a final checkpoint instead of
+    # dying mid-step.  MXNET_LIFECYCLE_SIGNALS=0 opts out for embedders
+    # that own their signal dispositions.
+    from .. import env as _env
+    from .. import lifecycle
+
+    lifecycle.coordinate_stops(True)
+    if _env.get_bool("MXNET_LIFECYCLE_SIGNALS", True):
+        lifecycle.install_signal_handlers()
     return True
 
 

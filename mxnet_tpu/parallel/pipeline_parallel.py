@@ -78,16 +78,23 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
     def leaf_spec(leaf):
         return P(axis, *([None] * (leaf.ndim - 1)))
 
-    pspecs = jax.tree_util.tree_map(leaf_spec, stage_params)
     traced = any(isinstance(leaf, jax.core.Tracer)
                  for leaf in jax.tree_util.tree_leaves(stage_params))
     if traced:
-        # inside an outer jit (TrainStep): annotate, don't device_put
-        stage_params = jax.tree_util.tree_map(
-            lambda leaf, spec: jax.lax.with_sharding_constraint(
-                leaf, NamedSharding(mesh, spec)),
-            stage_params, pspecs)
+        # Inside an outer jit (TrainStep): the stage params were stacked
+        # by TRACED ops, and feeding that product into shard_map with a
+        # P(axis) spec miscompiles under GSPMD when the mesh carries
+        # more axes than pp (observed on XLA:CPU, jax 0.4.37: garbage
+        # outputs on a dp×pp mesh even with check_rep on and replicated
+        # batch — the exact composition TrainStep(pipeline=...) builds;
+        # eager shard_map of the identical program is correct).  Route
+        # around the partitioner: pass the stacked tree in REPLICATED
+        # (P()) and let each device gather its own stage by axis index
+        # inside the body.  Memory is unchanged for the TrainStep path —
+        # its source params are replicated storage anyway.
+        pspecs = jax.tree_util.tree_map(lambda leaf: P(), stage_params)
     else:
+        pspecs = jax.tree_util.tree_map(leaf_spec, stage_params)
         stage_params = jax.tree_util.tree_map(
             lambda leaf, spec: jax.device_put(leaf,
                                               NamedSharding(mesh, spec)),
@@ -137,7 +144,17 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
         return jax.lax.psum(outputs, axis), saved
 
     def pp_fn(params_local, xs):
-        p_one = jax.tree_util.tree_map(lambda l: l[0], params_local)
+        if traced:
+            # replicated-in params: each device selects its stage (the
+            # gather's transpose scatter-adds grads back to the right
+            # stage slice, so AD composes)
+            s0 = jax.lax.axis_index(axis)
+            p_one = jax.tree_util.tree_map(
+                lambda l: jax.lax.dynamic_index_in_dim(
+                    l, s0, 0, keepdims=False), params_local)
+        else:
+            # weight-stationary: P(axis) left one stage per device
+            p_one = jax.tree_util.tree_map(lambda l: l[0], params_local)
 
         if schedule == "gpipe":
             out, _ = run_forward(xs, p_one, jax.lax.axis_index(axis),

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["seed"]
+__all__ = ["seed", "get_state", "set_state"]
 
 
 class _RngState(threading.local):
@@ -32,6 +32,31 @@ def _jr():
 def seed(seed_state, ctx="all"):
     """Seed the global RNG (reference: mx.random.seed)."""
     _S.key = _jr().PRNGKey(int(seed_state))
+
+
+def get_state():
+    """JSON-able global-RNG state (the PRNG key words as a list of ints;
+    None = never seeded).  Thread-local: capture on the training thread.
+    With :func:`set_state` this makes the stateful-draw sequence resume
+    bit-identically across a checkpoint/restore boundary
+    (lifecycle.capture_train_state)."""
+    if _S.key is None:
+        return None
+    import numpy as np
+
+    return [int(w) for w in np.asarray(_S.key).ravel()]
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot (None clears back to the
+    unseeded default)."""
+    if state is None:
+        _S.key = None
+        return
+    import numpy as np
+    import jax.numpy as jnp
+
+    _S.key = jnp.asarray(np.asarray(state, dtype=np.uint32))
 
 
 def _next_key():

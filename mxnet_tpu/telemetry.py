@@ -54,7 +54,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "render_prometheus", "start_http_server", "stop_http_server",
            "step_begin", "step_end", "step_abort", "step_scope", "phase",
            "maybe_phase", "timeline", "compile_event", "compile_events",
-           "reset"]
+           "heartbeat", "last_heartbeat", "reset"]
 
 _LOCK = threading.RLock()
 _FAMILIES: dict = {}        # name -> _Family
@@ -302,10 +302,29 @@ def _chrome_span(name, t0, t1, cat):
         pass
 
 
+# step heartbeat: monotonic timestamp of the last step-boundary activity
+# (step_begin/step_end, or an explicit heartbeat() from a custom loop /
+# lifecycle.check_stop).  The lifecycle watchdog reads it to enforce a
+# per-step deadline; None = no step activity yet this process.
+_HEARTBEAT = [None]
+
+
+def heartbeat():
+    """Mark step-boundary liveness for the stall watchdog
+    (:mod:`mxnet_tpu.lifecycle`).  Cheap: one monotonic read + store."""
+    _HEARTBEAT[0] = time.monotonic()
+
+
+def last_heartbeat():
+    """Monotonic time of the last heartbeat, or None."""
+    return _HEARTBEAT[0]
+
+
 def step_begin(step=None):
     """Open a timeline step.  An unfinished previous step is finalized
     first (robustness beats strictness in a training loop)."""
     global _CUR
+    heartbeat()
     with _LOCK:
         if _CUR is not None:
             _finalize_locked(time.perf_counter())
@@ -353,6 +372,7 @@ def _finalize_locked(now):
 def step_end():
     """Close the active step; returns its record (phase durations sum to
     the step wall time — unattributed time lands in ``other``)."""
+    heartbeat()
     with _LOCK:
         return _finalize_locked(time.perf_counter())
 
@@ -675,6 +695,7 @@ def reset():
         _COMPILE_EVENTS.clear()
         _CUR = None
         _STEP_SEQ[0] = 0
+        _HEARTBEAT[0] = None
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,90 @@
+"""Worker for the 2-process coordinated-preemption test (launched via
+tools/launch.py -n 2; see tests/test_lifecycle.py).
+
+Both ranks train the same replicated model through the dist_tpu_sync
+KVStore, logging (step, loss) per step.  When PREEMPT_AT is set, rank 0
+calls ``lifecycle.request_stop`` programmatically right after that step
+— the OTHER rank must learn the stop through ``check_stop``'s agreement
+all-reduce and both must exit at the SAME step, with rank 0 (the
+checkpoint primary) publishing a final checkpoint carrying the
+exact-resume train_state.  A relaunch without PREEMPT_AT resumes and
+finishes; the supervising test asserts the combined per-step loss
+sequence is bit-identical to an uninterrupted 2-process run."""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu.parallel import distributed
+
+assert distributed.init(), "distributed.init must bootstrap from launcher env"
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, lifecycle
+from mxnet_tpu.checkpoint import CheckpointManager, run_with_recovery
+
+ckdir, log_base, total_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+preempt_at = int(os.environ.get("PREEMPT_AT", "-1"))
+rank = jax.process_index()
+log_path = f"{log_base}.{rank}"
+
+net = gluon.nn.Dense(1, in_units=4, prefix="pre2_")
+net.initialize(mx.init.Zero())
+kv = mx.kv.create("dist_tpu_sync")
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.05, "momentum": 0.9},
+                        kvstore=kv)
+mgr = CheckpointManager(ckdir, max_to_keep=3)
+true_w = np.array([[1.0, -2.0, 0.5, 3.0]], "f")
+
+
+def train_fn(start, manager):
+    step = manager.restore(net, trainer)
+    state = manager.read_train_state(step) if step else None
+    gstep = (lifecycle.restore_train_state(state) if state else 0) or 0
+    with open(log_path, "a") as log:
+        while gstep < total_steps:
+            rs = np.random.RandomState(1000 + gstep)  # same data both ranks
+            x = rs.randn(8, 4).astype("f")
+            y = x @ true_w.T
+            with autograd.record():
+                loss = ((net(mx.nd.array(x)) - mx.nd.array(y)) ** 2).mean()
+            loss.backward()
+            trainer.step(8)
+            log.write(json.dumps({"step": gstep,
+                                  "loss": float(loss.asnumpy())}) + "\n")
+            log.flush()
+            gstep += 1
+            mgr.save(gstep, net, trainer,
+                     train_state=lifecycle.capture_train_state(
+                         step=gstep, trainer=trainer))
+            if rank == 0 and gstep == preempt_at:
+                lifecycle.request_stop("simulated preemption on rank 0")
+            # rank 1 has no local stop: it must learn it HERE, through
+            # the agreement all-reduce, and exit at the same step
+            if lifecycle.check_stop():
+                lifecycle.publish_final_checkpoint(
+                    mgr, gstep, net, trainer,
+                    train_state=lifecycle.capture_train_state(
+                        step=gstep, trainer=trainer))
+                raise lifecycle.GracefulExit(
+                    lifecycle.stop_reason() or "stop", step=gstep)
+    return gstep
+
+
+try:
+    run_with_recovery(train_fn, mgr, max_restarts=1)
+except lifecycle.GracefulExit as e:
+    # launcher-friendly: record the distinct preempted-clean status in a
+    # marker instead of a nonzero exit code
+    with open(f"{log_base}.preempted.{rank}", "w") as f:
+        f.write(str(e.step))
+    sys.exit(0)
+with open(f"{log_base}.done.{rank}", "w") as f:
+    f.write("1")

@@ -1,0 +1,808 @@
+"""Preemption-safe training lifecycle (ISSUE 5): graceful shutdown,
+exact-resume train_state, and the stall watchdog.
+
+The chaos acceptance path (real SIGTERM against a child process, real
+watchdog abort) lives in ci/preemption_smoke.py; this suite covers the
+units and the in-process end-to-end exact-resume contract."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, fault, gluon, lifecycle, telemetry
+from mxnet_tpu.checkpoint import CheckpointManager, run_with_recovery
+from mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler, DataLoader,
+                                  RandomSampler)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_lifecycle():
+    lifecycle.reset()
+    fault.reset_stats()
+    yield
+    lifecycle.reset()
+    lifecycle.stop_watchdog()
+
+
+# --------------------------------------------------------------------------
+# stop flag + signals
+# --------------------------------------------------------------------------
+def test_stop_flag_request_and_reset():
+    assert not lifecycle.stop_requested()
+    assert not lifecycle.check_stop()
+    lifecycle.request_stop("because")
+    assert lifecycle.stop_requested()
+    assert lifecycle.stop_reason() == "because"
+    assert lifecycle.check_stop()
+    lifecycle.request_stop("second")           # first reason wins
+    assert lifecycle.stop_reason() == "because"
+    lifecycle.reset()
+    assert not lifecycle.check_stop()
+
+
+def test_check_stop_beats_watchdog_heartbeat():
+    telemetry.reset()
+    assert telemetry.last_heartbeat() is None
+    lifecycle.check_stop()
+    assert telemetry.last_heartbeat() is not None
+
+
+def test_sigterm_fault_seam_triggers_stop():
+    """Arming ``lifecycle.sigterm`` makes the next step-boundary poll act
+    like a delivered preemption signal (chaos-testable without kill)."""
+    with fault.inject("lifecycle.sigterm", times=1):
+        assert lifecycle.check_stop()
+    assert "fault-injected" in lifecycle.stop_reason()
+
+
+def test_signal_handler_sets_stop_flag():
+    import signal
+
+    assert lifecycle.install_signal_handlers()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5
+        while not lifecycle.stop_requested() and time.time() < deadline:
+            time.sleep(0.01)
+        assert lifecycle.stop_requested()
+        assert "SIGTERM" in lifecycle.stop_reason()
+    finally:
+        lifecycle.uninstall_signal_handlers()
+
+
+def test_grace_deadline_disarmed_when_stop_honored(monkeypatch):
+    """Honoring the stop (constructing GracefulExit) cancels the
+    MXNET_GRACE_PERIOD_S force-exit timer — a caller that catches the
+    exception and lives on must not be os._exit'd later."""
+    monkeypatch.setenv("MXNET_GRACE_PERIOD_S", "30")
+    lifecycle._arm_grace_deadline()
+    t = lifecycle._GRACE["timer"]
+    assert t is not None and t.is_alive()
+    lifecycle.GracefulExit("honored", step=1)
+    assert lifecycle._GRACE["timer"] is None
+    deadline = time.time() + 2
+    while t.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not t.is_alive()
+
+
+def test_allreduce_any_single_process():
+    from mxnet_tpu.parallel.collectives import allreduce_any
+
+    assert allreduce_any(True) is True
+    assert allreduce_any(False) is False
+    # forced combine path (the real collective machinery on one process)
+    assert allreduce_any(True, _testing_force=True) is True
+    assert allreduce_any(False, _testing_force=True) is False
+
+
+def test_check_stop_agreement_stride(monkeypatch):
+    """MXNET_STOP_SYNC_EVERY amortizes the agreement collective: with
+    N=3 only every third call reaches allreduce_any, by pure call count
+    (never flag-conditional — that would desync peers)."""
+    import jax
+
+    from mxnet_tpu.parallel import collectives
+
+    calls = []
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(collectives, "allreduce_any",
+                        lambda flag: (calls.append(1), bool(flag))[1])
+    monkeypatch.setenv("MXNET_STOP_SYNC_EVERY", "3")
+    lifecycle._SYNC["calls"] = 0
+    for _ in range(6):
+        assert lifecycle.check_stop(sync=True) is False
+    assert len(calls) == 2                 # calls 3 and 6 only
+    # a locally-set flag must NOT drive the loop off-cycle: only the
+    # AGREED verdict may (a lone rank exiting early strands its peers
+    # in their next collective).  The next on-cycle call agrees it.
+    lifecycle.request_stop("local")
+    assert lifecycle.check_stop(sync=True) is False    # call 7: off-cycle
+    assert lifecycle.check_stop(sync=True) is False    # call 8: off-cycle
+    assert len(calls) == 2
+    assert lifecycle.check_stop(sync=True) is True     # call 9: collective
+    assert len(calls) == 3
+    assert lifecycle.check_stop(sync=True) is True     # 10: sticky agreed
+    assert len(calls) == 3
+
+
+# --------------------------------------------------------------------------
+# exact-resume state units
+# --------------------------------------------------------------------------
+def test_random_state_roundtrip():
+    mx.random.seed(123)
+    st = mx.random.get_state()
+    a = mx.random.uniform(shape=(4,)).asnumpy()
+    b = mx.random.uniform(shape=(4,)).asnumpy()
+    mx.random.set_state(st)
+    a2 = mx.random.uniform(shape=(4,)).asnumpy()
+    b2 = mx.random.uniform(shape=(4,)).asnumpy()
+    np.testing.assert_array_equal(a, a2)
+    np.testing.assert_array_equal(b, b2)
+    assert json.loads(json.dumps(st)) == st   # JSON-able for train_state
+
+
+def test_random_sampler_deterministic_per_seed_epoch():
+    s1 = RandomSampler(10, seed=42)
+    s2 = RandomSampler(10, seed=42)
+    assert list(s1) == list(s2)
+    assert list(s1) != list(s1)            # epochs advance -> new shuffle
+    s2.set_epoch(5)
+    s3 = RandomSampler(10, seed=42)
+    s3.set_epoch(5)
+    assert list(s2) == list(s3)
+    st = s3.state_dict()                   # next-epoch position
+    s4 = RandomSampler(10)
+    s4.load_state_dict(st)
+    assert list(s4) == list(s3)
+
+
+def test_batch_sampler_rollover_state_roundtrip():
+    bs = BatchSampler(RandomSampler(10, seed=1), 3, last_batch="rollover")
+    list(bs)                               # leaves a carry in _prev
+    st = bs.state_dict()
+    assert st["prev"]                      # 10 % 3 = 1 carried index
+    bs2 = BatchSampler(RandomSampler(10), 3, last_batch="rollover")
+    bs2.load_state_dict(st)
+    bs2.set_epoch(1)
+    bs.set_epoch(1)
+    assert [list(b) for b in bs2] == [list(b) for b in bs]
+
+
+class _CountingDataset(ArrayDataset):
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.fetches = 0
+
+    def __getitem__(self, idx):
+        self.fetches += 1
+        return super().__getitem__(idx)
+
+
+def _loader(n=20, bs=3, **kw):
+    return DataLoader(_CountingDataset(np.arange(n, dtype="f")),
+                      batch_size=bs, shuffle=True, last_batch="keep", **kw)
+
+
+def test_dataloader_state_resume_bit_identical_and_decode_free():
+    dl = _loader()
+    it = iter(dl)
+    first = [next(it).asnumpy().tolist() for _ in range(3)]
+    state = dl.state_dict()
+    assert state["batch"] == 3
+
+    # resumed loader: same sequence continuation, skipped batches never
+    # touch the dataset (decode-free fast-forward)
+    dl2 = _loader()
+    dl2.load_state_dict(state)
+    rest = [b.asnumpy().tolist() for b in dl2]
+    assert dl2._dataset.fetches == 20 - 9   # 3 skipped batches x 3 items
+
+    # uninterrupted reference with the same sampler seed
+    dl3 = _loader()
+    dl3.load_state_dict({"epoch": 0, "batch": 0,
+                         "sampler": state["sampler"]})
+    full = [b.asnumpy().tolist() for b in dl3]
+    assert first + rest == full
+
+
+def test_dataloader_state_resume_across_epoch_boundary():
+    dl = _loader(n=9, bs=3)                # 3 batches per epoch
+    consumed = []
+    for _ in range(2):                     # epochs 0 and 1 fully
+        consumed.extend(b.asnumpy().tolist() for b in dl)
+    it = iter(dl)                          # epoch 2, one batch in
+    consumed.append(next(it).asnumpy().tolist())
+    state = dl.state_dict()
+    assert state["epoch"] == 2 and state["batch"] == 1
+
+    dl2 = _loader(n=9, bs=3)
+    dl2.load_state_dict(state)
+    rest = [b.asnumpy().tolist() for b in dl2]
+
+    dl3 = _loader(n=9, bs=3)
+    dl3.load_state_dict({"epoch": 0, "batch": 0,
+                         "sampler": {"sampler": {
+                             "seed": state["sampler"]["sampler"]["seed"],
+                             "epoch": 0}, "prev": []}})
+    full = []
+    for _ in range(3):
+        full.extend(b.asnumpy().tolist() for b in dl3)
+    assert consumed + rest == full
+
+
+def test_dataloader_state_resume_threaded_workers():
+    dl = _loader(num_workers=2)
+    it = iter(dl)
+    first = [next(it).asnumpy().tolist() for _ in range(4)]
+    state = dl.state_dict()
+    dl2 = _loader(num_workers=2)
+    dl2.load_state_dict(state)
+    rest = [b.asnumpy().tolist() for b in dl2]
+    dl3 = _loader(num_workers=2)
+    dl3.load_state_dict({"epoch": 0, "batch": 0,
+                         "sampler": state["sampler"]})
+    full = [b.asnumpy().tolist() for b in dl3]
+    assert first + rest == full
+
+
+def test_loss_scaler_state_roundtrip():
+    from mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+
+    s = LossScaler(init_scale=2.0 ** 10, scale_window=5)
+    s.update_scale(True)                   # halve, reset counter
+    s.update_scale(False)
+    st = s.state_dict()
+    s2 = LossScaler(init_scale=2.0 ** 10, scale_window=5)
+    s2.load_state_dict(st)
+    assert s2.loss_scale == s.loss_scale
+    assert s2._unskipped == s._unskipped
+    # identical continuation: 4 more clean steps double both at once
+    for _ in range(4):
+        s.update_scale(False)
+        s2.update_scale(False)
+    assert s2.loss_scale == s.loss_scale
+
+
+# --------------------------------------------------------------------------
+# fused overflow check (satellite: K host syncs -> 1)
+# --------------------------------------------------------------------------
+def _params_with_grads():
+    net = gluon.nn.Dense(3, in_units=4)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 4).astype("f"))
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    return list(net.collect_params().values())
+
+
+def _reference_has_overflow(params):
+    """The pre-fusion per-param verdict (the numerics oracle)."""
+    import jax.numpy as jnp
+
+    for p in params:
+        if p.grad_req == "null" or p._data is None:
+            continue
+        for g in p.list_grad():
+            v = g._get()
+            if not jnp.issubdtype(v.dtype, jnp.floating):
+                continue
+            if not bool(jnp.isfinite(v).all()):
+                return True
+    return False
+
+
+@pytest.mark.parametrize("poison", [None, "inf", "-inf", "nan"])
+@pytest.mark.parametrize("where", [0, -1])
+def test_loss_scaler_fused_overflow_matches_reference(poison, where):
+    """Satellite 1: the fused single-host-sync verdict must be identical
+    to the old per-param ``isfinite(v).all()`` loop for every poison
+    class and position."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+
+    params = _params_with_grads()
+    if poison is not None:
+        g = params[where].list_grad()[0]
+        arr = g.asnumpy().copy()
+        arr.flat[arr.size // 2] = float(poison)
+        g._set(jnp.asarray(arr))
+    want = _reference_has_overflow(params)
+    got = LossScaler().has_overflow(params)
+    assert got == want
+    assert got == (poison is not None)
+
+
+def test_loss_scaler_fused_overflow_skips_frozen_and_empty():
+    from mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+
+    params = _params_with_grads()
+    for p in params:
+        p.grad_req = "null"
+    assert LossScaler().has_overflow(params) is False
+    assert LossScaler().has_overflow([]) is False
+
+
+# --------------------------------------------------------------------------
+# checkpoint train_state + recovery semantics
+# --------------------------------------------------------------------------
+def test_checkpoint_train_state_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    ts = lifecycle.capture_train_state(step=3, extra={"tag": "x"})
+    mgr.save(3, train_state=ts)
+    back = mgr.read_train_state(3)
+    assert back["step"] == 3 and back["extra"] == {"tag": "x"}
+    assert back["rng"] == ts["rng"]
+    assert mgr.read_train_state(99) is None
+    mgr.save(4)                            # no train_state passed
+    assert mgr.read_train_state(4) is None
+
+
+def test_checkpoint_train_state_async_and_checksummed(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, train_state={"step": 1}, async_=True)
+    mgr.close()
+    assert mgr.read_train_state(1)["step"] == 1
+    # the train_state file is under the sha256 manifest: corruption is
+    # detected like any payload
+    meta = mgr.read_meta(1)
+    assert "train_state.json" in meta["files"]
+    path = os.path.join(mgr._step_dir(1), "train_state.json")
+    with open(path, "w") as f:
+        f.write('{"step": 666}')
+    assert mgr.verify(1) is not None
+
+
+def test_capture_restore_train_state_bundle():
+    from mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+
+    dl = _loader()
+    next(iter(dl))
+    scaler = LossScaler(init_scale=16.0)
+    scaler.update_scale(True)
+    mx.random.seed(9)
+    ts = lifecycle.capture_train_state(step=7, dataloader=dl, scaler=scaler)
+    draw = mx.random.uniform(shape=(2,)).asnumpy()
+
+    dl2 = _loader()
+    scaler2 = LossScaler()
+    step = lifecycle.restore_train_state(ts, dataloader=dl2, scaler=scaler2)
+    assert step == 7
+    assert scaler2.loss_scale == scaler.loss_scale
+    np.testing.assert_array_equal(
+        mx.random.uniform(shape=(2,)).asnumpy(), draw)
+    assert dl2._resume is not None
+
+
+def test_run_with_recovery_graceful_exit_not_counted(tmp_path):
+    """A GracefulExit is preempted-clean: re-raised, never retried, never
+    counted against the restart budget (max_restarts=0 would otherwise
+    convert the first failure into MXNetError)."""
+    mgr = CheckpointManager(str(tmp_path))
+    calls = []
+
+    def train(start, manager):
+        calls.append(start)
+        raise lifecycle.GracefulExit("preempted", step=start)
+
+    with pytest.raises(lifecycle.GracefulExit):
+        run_with_recovery(train, mgr, max_restarts=0)
+    assert calls == [0]                    # exactly one attempt, no retry
+
+
+def test_run_with_recovery_normal_failure_still_counts(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+
+    def train(start, manager):
+        raise RuntimeError("boom")
+
+    with pytest.raises(mx.MXNetError, match="restarts"):
+        run_with_recovery(train, mgr, max_restarts=1, backoff_ms=0)
+
+
+def test_publish_final_checkpoint_honors_knob(tmp_path, monkeypatch):
+    mgr = CheckpointManager(str(tmp_path))
+    monkeypatch.setenv("MXNET_PREEMPTION_CHECKPOINT", "0")
+    assert lifecycle.publish_final_checkpoint(mgr, 1) is None
+    assert mgr.all_steps() == []
+    monkeypatch.delenv("MXNET_PREEMPTION_CHECKPOINT")
+    assert lifecycle.publish_final_checkpoint(mgr, 1) is not None
+    assert mgr.all_steps() == [1]
+
+
+# --------------------------------------------------------------------------
+# training-loop integration
+# --------------------------------------------------------------------------
+def test_estimator_fit_graceful_stop_publishes_final_checkpoint(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    est = Estimator(net, lambda o, l: ((o - l) ** 2).mean(),
+                    train_metrics=["mse"], trainer=trainer)
+    X = np.random.RandomState(0).randn(24, 4).astype("f")
+    Y = X.sum(axis=1, keepdims=True).astype("f")
+    dl = DataLoader(ArrayDataset(X, Y), batch_size=4, shuffle=True)
+    mgr = CheckpointManager(str(tmp_path))
+
+    # the first step-boundary poll trips the armed preemption seam
+    with fault.inject("lifecycle.sigterm", times=1):
+        with pytest.raises(lifecycle.GracefulExit) as ei:
+            est.fit(dl, epochs=4, checkpoint_manager=mgr)
+    stop_step = ei.value.step
+    assert stop_step == est.global_step == 1   # first boundary after arm
+    assert mgr.latest_valid_step() == stop_step
+    ts = mgr.read_train_state(stop_step)
+    assert ts["step"] == stop_step
+    assert ts["dataloader"]["batch"] == 1
+    assert ts["trainer"]["num_update"] == trainer.step_count
+
+
+def test_estimator_fit_without_manager_still_stops():
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {})
+    est = Estimator(net, lambda o, l: ((o - l) ** 2).mean(),
+                    train_metrics=["mse"], trainer=trainer)
+    X = np.zeros((8, 4), "f")
+    dl = DataLoader(ArrayDataset(X, X[:, :1]), batch_size=4)
+    lifecycle.request_stop("operator")
+    with pytest.raises(lifecycle.GracefulExit):
+        est.fit(dl, epochs=1)
+
+
+def test_trainstep_run_stops_at_step_boundary():
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    net(mx.nd.zeros((1, 3)))
+    step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(),
+                     optimizer="sgd")
+    rs = np.random.RandomState(0)
+    batches = [(rs.randn(4, 3).astype("f"), rs.randn(4, 2).astype("f"))
+               for _ in range(6)]
+    losses = step.run(batches, prefetch=0)
+    assert len(losses) == 6 and step.step_count == 6
+    # a pre-existing stop exits at the FIRST boundary: zero steps taken
+    lifecycle.request_stop("now")
+    assert step.run(batches, prefetch=0) == []
+    lifecycle.reset()
+
+    class StopAfter2:
+        def __init__(self):
+            self.n = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.n += 1
+            if self.n == 3:
+                lifecycle.request_stop("mid-run")
+            if self.n > len(batches):
+                raise StopIteration
+            return batches[self.n - 1]
+
+    out = step.run(StopAfter2(), prefetch=0)
+    assert len(out) == 3                     # stops at the NEXT boundary
+    assert lifecycle.stop_requested()
+
+
+# --------------------------------------------------------------------------
+# watchdog
+# --------------------------------------------------------------------------
+def test_watchdog_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv("MXNET_WATCHDOG_TIMEOUT_S", raising=False)
+    wd = lifecycle.Watchdog(abort=False)
+    assert wd.timeout_s == 0
+    wd.start()
+    assert wd._thread is None
+    wd.stop()
+
+
+def test_watchdog_detects_real_stall_and_rearms(tmp_path):
+    telemetry.heartbeat()
+    wd = lifecycle.Watchdog(timeout_s=0.15, abort=False,
+                            dump_dir=str(tmp_path), poll_s=0.03)
+    wd.start()
+    try:
+        time.sleep(0.6)
+        assert wd.stall_count == 1          # fires ONCE per stall
+        doc = json.load(open(wd.last_dump))
+        assert doc["stacks"] and doc["timeout_s"] == 0.15
+        assert "mxnet_watchdog_stalls_total" in doc["telemetry"]["metrics"]
+        telemetry.heartbeat()               # recover...
+        time.sleep(0.5)                     # ...then stall again
+        assert wd.stall_count == 2          # re-armed by the new heartbeat
+    finally:
+        wd.stop()
+
+
+def test_watchdog_stall_fault_seam(tmp_path):
+    telemetry.heartbeat()
+    wd = lifecycle.Watchdog(timeout_s=0.3, abort=False,
+                            dump_dir=str(tmp_path), poll_s=0.02)
+    wd.start()
+    try:
+        with fault.inject("watchdog.stall", times=1):
+            deadline = time.time() + 5
+            while wd.last_dump is None and time.time() < deadline:
+                time.sleep(0.02)
+        assert wd.stall_count == 1
+        assert "injected" in json.load(open(wd.last_dump))["cause"]
+        # an injected fire must not consume the per-stall one-shot: a
+        # REAL stall at the same heartbeat base still gets diagnosed
+        time.sleep(0.6)
+        assert wd.stall_count == 2
+    finally:
+        wd.stop()
+
+
+def test_watchdog_stands_down_during_stop_with_grace(tmp_path,
+                                                     monkeypatch):
+    """While a stop is pending AND the grace deadline is armed, that
+    deadline owns termination: the watchdog must not kill the
+    (legitimately long) final synchronous checkpoint as a stall."""
+    monkeypatch.setenv("MXNET_GRACE_PERIOD_S", "60")
+    telemetry.heartbeat()
+    wd = lifecycle.Watchdog(timeout_s=0.1, abort=False,
+                            dump_dir=str(tmp_path), poll_s=0.02)
+    wd.start()
+    try:
+        lifecycle.request_stop("preempted")  # arms the 60s grace timer
+        assert lifecycle._GRACE["timer"] is not None
+        time.sleep(0.4)                     # would trip 3x if enforced
+        assert wd.stall_count == 0
+        lifecycle.reset()                   # clears stop + cancels timer
+        telemetry.heartbeat()
+        time.sleep(0.4)                     # enforcement back
+        assert wd.stall_count == 1
+    finally:
+        wd.stop()
+
+
+def test_watchdog_keeps_enforcing_on_stop_without_grace(tmp_path,
+                                                        monkeypatch):
+    """With NO grace deadline configured, a stop request must not blind
+    the watchdog — a final save wedged on a dead peer's barrier would
+    otherwise hang forever with no diagnosis."""
+    monkeypatch.delenv("MXNET_GRACE_PERIOD_S", raising=False)
+    telemetry.heartbeat()
+    wd = lifecycle.Watchdog(timeout_s=0.1, abort=False,
+                            dump_dir=str(tmp_path), poll_s=0.02)
+    wd.start()
+    try:
+        lifecycle.request_stop("preempted")  # no timer armed (grace off)
+        assert lifecycle._GRACE["timer"] is None
+        time.sleep(0.4)
+        assert wd.stall_count == 1           # still diagnosed
+    finally:
+        wd.stop()
+
+
+def test_watchdog_startup_allowance_before_first_heartbeat(tmp_path):
+    """No heartbeat yet = the first step is still compiling/warming: the
+    deadline is 10x until the first beat lands."""
+    telemetry.reset()                       # clear any prior heartbeat
+    wd = lifecycle.Watchdog(timeout_s=0.2, abort=False,
+                            dump_dir=str(tmp_path), poll_s=0.02)
+    wd.start()
+    try:
+        time.sleep(0.6)                     # 3x past the deadline
+        assert wd.stall_count == 0          # ...but inside the 10x window
+        telemetry.heartbeat()               # first beat: steady state now
+        time.sleep(0.5)
+        assert wd.stall_count == 1
+    finally:
+        wd.stop()
+
+
+def test_checkpoint_save_beats_watchdog_heartbeat(tmp_path):
+    telemetry.reset()
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, train_state={"step": 1})
+    assert telemetry.last_heartbeat() is not None
+
+
+def test_dataloader_resume_warns_without_sampler_state(tmp_path):
+    """A custom batch_sampler with no state_dict cannot be replayed: the
+    resume must say so instead of silently skipping batches of a
+    different order."""
+    class Custom:                           # no state_dict/load_state_dict
+        def __iter__(self):
+            return iter([[0, 1], [2, 3], [4, 5]])
+
+        def __len__(self):
+            return 3
+
+    ds = ArrayDataset(np.arange(6, dtype="f"))
+    dl = DataLoader(ds, batch_sampler=Custom())
+    it = iter(dl)
+    next(it)
+    state = dl.state_dict()
+    assert state["sampler"] is None and state["batch"] == 1
+    dl2 = DataLoader(ArrayDataset(np.arange(6, dtype="f")),
+                     batch_sampler=Custom())
+    dl2.load_state_dict(state)
+    with pytest.warns(UserWarning, match="no state"):
+        out = [b.asnumpy().tolist() for b in dl2]
+    assert len(out) == 2                   # count-only fast-forward
+
+    class HalfStateful(Custom):            # captures state, can't restore
+        def state_dict(self):
+            return {"x": 1}
+
+    dl3 = DataLoader(ArrayDataset(np.arange(6, dtype="f")),
+                     batch_sampler=HalfStateful())
+    next(iter(dl3))
+    st3 = dl3.state_dict()
+    assert st3["sampler"] == {"x": 1}
+    dl4 = DataLoader(ArrayDataset(np.arange(6, dtype="f")),
+                     batch_sampler=HalfStateful())
+    dl4.load_state_dict(st3)
+    with pytest.warns(UserWarning, match="cannot restore"):
+        assert len(list(dl4)) == 2
+
+
+def test_watchdog_counter_in_prometheus(tmp_path):
+    telemetry.heartbeat()
+    wd = lifecycle.Watchdog(timeout_s=60, abort=False,
+                            dump_dir=str(tmp_path), poll_s=0.02)
+    wd.start()
+    try:
+        with fault.inject("watchdog.stall", times=1):
+            deadline = time.time() + 5
+            while wd.stall_count == 0 and time.time() < deadline:
+                time.sleep(0.02)
+    finally:
+        wd.stop()
+    text = telemetry.render_prometheus()
+    for line in text.splitlines():
+        if line.startswith("mxnet_watchdog_stalls_total"):
+            assert float(line.split()[-1]) >= 1
+            break
+    else:
+        pytest.fail("mxnet_watchdog_stalls_total not exported")
+
+
+# --------------------------------------------------------------------------
+# end-to-end exact resume (single process, in-process "restart")
+# --------------------------------------------------------------------------
+def _train_loop(ckdir, total_steps, stop_at=None):
+    """One 'process attempt': build everything fresh (as a restarted
+    process would), restore, train, optionally request a stop after
+    ``stop_at`` steps.  Returns the (step, ids, loss) records produced by
+    THIS attempt."""
+    np.random.seed(0)      # the fresh-sampler seed draw, like a new process
+    rs = np.random.RandomState(7)
+    X = rs.randn(36, 4).astype("f")
+    W = np.array([[1.0, -2.0, 0.5, 3.0]], "f")
+    Y = (X @ W.T).astype("f")
+    IDX = np.arange(36, dtype="f")
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize(mx.init.Zero())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    loader = DataLoader(ArrayDataset(X, Y, IDX), batch_size=4, shuffle=True)
+    mgr = CheckpointManager(ckdir)
+    step = mgr.restore(net, trainer)
+    state = mgr.read_train_state(step) if step else None
+    gstep = (lifecycle.restore_train_state(state, dataloader=loader)
+             if state else 0) or 0
+    records = []
+    while gstep < total_steps:
+        for batch in loader:
+            x, y, idx = batch
+            with autograd.record():
+                loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            trainer.step(x.shape[0])
+            records.append((gstep, idx.asnumpy().astype(int).tolist(),
+                            float(loss.asnumpy())))
+            gstep += 1
+            mgr.save(gstep, net, trainer,
+                     train_state=lifecycle.capture_train_state(
+                         step=gstep, dataloader=loader, trainer=trainer))
+            if stop_at is not None and gstep == stop_at:
+                lifecycle.request_stop("test preemption")
+            if lifecycle.check_stop():
+                lifecycle.publish_final_checkpoint(
+                    mgr, gstep, net, trainer,
+                    train_state=lifecycle.capture_train_state(
+                        step=gstep, dataloader=loader, trainer=trainer))
+                raise lifecycle.GracefulExit("test", step=gstep)
+            if gstep >= total_steps:
+                break
+    return records
+
+
+@pytest.mark.parametrize("stop_at", [4, 11])   # mid-epoch and epoch-crossing
+def test_exact_resume_single_process(tmp_path, stop_at):
+    """Satellite 3 (single-process): train N steps recording the batch-id
+    and loss sequence, preempt at step k through the lifecycle stop path,
+    resume, and assert the full sequence is bit-identical to an
+    uninterrupted run (epoch length is 9 batches, so stop_at=11 resumes
+    INSIDE epoch 1)."""
+    total = 15
+    ref = _train_loop(str(tmp_path / "ref"), total)
+    assert len(ref) == total
+
+    with pytest.raises(lifecycle.GracefulExit):
+        _train_loop(str(tmp_path / "run"), total, stop_at=stop_at)
+    lifecycle.reset()
+    part1_steps = stop_at
+    part2 = _train_loop(str(tmp_path / "run"), total)
+    assert [r[0] for r in part2] == list(range(part1_steps, total))
+    # bit-identical tail: same batches, same losses to the last bit
+    assert part2 == ref[part1_steps:]
+
+
+@pytest.mark.slow
+def test_two_process_coordinated_preemption_exact_resume(tmp_path):
+    """Satellite 3 (2-process): rank 0 requests a stop; rank 1 must learn
+    it through the agreement all-reduce and exit at the SAME step; the
+    relaunched pair resumes bit-identically vs an uninterrupted 2-process
+    run.
+
+    Like test_two/four_process_dist_kvstore this needs a backend with
+    real multiprocess collectives (the virtual-device CPU backend raises
+    INVALID_ARGUMENT for cross-process computations) — it runs in the
+    dist lane on hardware, not in tier-1."""
+    env_base = dict(os.environ)
+    env_base["PYTHONPATH"] = REPO + os.pathsep + env_base.get(
+        "PYTHONPATH", "")
+    env_base["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env_base["MXNET_FAULT_BACKOFF_MS"] = "1"
+    total = 8
+
+    def launch(ckdir, log_base, preempt_at=None):
+        env = dict(env_base)
+        if preempt_at is not None:
+            env["PREEMPT_AT"] = str(preempt_at)
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", "2", "--launcher", "local", "--",
+             sys.executable, os.path.join(REPO, "tests",
+                                          "preemption_worker.py"),
+             ckdir, log_base, str(total)],
+            env=env, capture_output=True, text=True, timeout=420)
+
+    def read(log_base, rank):
+        with open(f"{log_base}.{rank}") as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    ref_base = str(tmp_path / "ref")
+    proc = launch(str(tmp_path / "ck_ref"), ref_base)
+    assert proc.returncode == 0, proc.stderr
+    ref = read(ref_base, 0)
+    assert len(ref) == total
+
+    run_base = str(tmp_path / "run")
+    ck_run = str(tmp_path / "ck_run")
+    proc = launch(ck_run, run_base, preempt_at=3)
+    assert proc.returncode == 0, proc.stderr
+    for rank in (0, 1):
+        with open(f"{run_base}.preempted.{rank}") as f:
+            assert int(f.read()) == 3      # BOTH ranks stopped at step 3
+        assert len(read(run_base, rank)) == 3
+
+    proc = launch(ck_run, run_base)        # resume to completion
+    assert proc.returncode == 0, proc.stderr
+    for rank in (0, 1):
+        assert os.path.exists(f"{run_base}.done.{rank}")
+        combined = read(run_base, rank)
+        assert combined == ref, (combined, ref)
