@@ -86,6 +86,16 @@ Wired vars (read at ``import mxnet_tpu``):
   collective every N-th ``lifecycle.check_stop()`` call (default 1;
   larger N amortizes the per-step scalar all-reduce, stop latency grows
   to at most N steps).
+- ``MXNET_SUBGRAPH_BACKEND``: subgraph backend applied automatically at
+  Module bind time (see :mod:`mxnet_tpu.subgraph`; unset = none).
+- ``MXNET_NUM_WORKERS``: launcher-provided world size for
+  ``parallel.distributed.init`` (``DMLC_NUM_WORKER`` is the legacy
+  alias; default 1 = single process).
+- ``MXNET_WORKER_ID``: launcher-provided rank for
+  ``parallel.distributed.init`` and the checkpoint manager's
+  primary-election sweep (``DMLC_WORKER_ID`` is the legacy alias).
+  Read from the LAUNCHER env on purpose — rank must be knowable before
+  the jax backend initializes.
 
 Accepted-but-subsumed (XLA owns the concern; reads return the default and
 ``describe()`` says why):
@@ -269,6 +279,12 @@ def describe():
          "SIGTERM/SIGINT handlers (default 1)"),
         ("MXNET_STOP_SYNC_EVERY", "stop-agreement collective every N-th "
          "check_stop (default 1; N steps max stop latency)"),
+        ("MXNET_SUBGRAPH_BACKEND", "subgraph backend applied at Module "
+         "bind time (mxnet_tpu.subgraph; unset = none)"),
+        ("MXNET_NUM_WORKERS", "launcher world size for distributed.init "
+         "(alias DMLC_NUM_WORKER; default 1)"),
+        ("MXNET_WORKER_ID", "launcher rank for distributed.init + "
+         "checkpoint primary election (alias DMLC_WORKER_ID)"),
     ]
     for name, what in wired:
         lines.append(f"{name}={os.environ.get(name, '<unset>')} — {what}")

@@ -196,6 +196,10 @@ def check_stop(sync=None):
     local = _STOP["requested"]
     if sync is None:
         sync = _SYNC["enabled"]
+    # the agreement collective's issue count is a pure function of the
+    # per-process call count (the documented stride contract above) —
+    # `sync` is process-lifetime config, not per-step state:
+    # mxtpu: noqa[MXT003]
     if sync:
         import jax
 

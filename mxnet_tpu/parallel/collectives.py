@@ -5,6 +5,33 @@ Reference mapping (SURVEY.md §6.8): these replace the reference's reducers —
 NCCL (kvstore_nccl.h) and the ps-lite push/pull — with XLA collectives that
 ride ICI/DCN.  Inside ``shard_map`` use the ``p*`` wrappers; at the array
 level use the host-sharding helpers.
+
+The equal-call-count contract
+-----------------------------
+Every SPMD peer must issue the SAME collectives in the SAME program
+order — XLA collectives rendezvous by issue order, not by name, so a
+rank that issues one extra (or one fewer) collective pairs every later
+collective with the wrong peer op and the mesh hangs or computes
+garbage.  Machine-enforced by ``python -m tools.check`` (pass
+``collective-safety``, codes MXT001-MXT003; see README "Static
+analysis").  Concretely:
+
+- never issue a collective under a rank-conditional branch
+  (``jax.process_index()``, ``kv.rank``, launcher-rank env vars).
+  Uniform guards — ``jax.process_count()``, configuration every process
+  constructs identically — are fine: all ranks take the same arm.
+- never retry a collective unilaterally (PR 2): the peers never issue
+  the matching re-run.  A transient interconnect failure escalates to
+  ``checkpoint.run_with_recovery``'s whole-job restart; only
+  single-process paths retry locally (see ``_combine_with_seam``).
+- branches whose arms issue different collective counts must derive
+  their condition from rank-uniform state.  Audited examples of the
+  uniform kind: ``lifecycle.check_stop``'s agreement stride is a pure
+  function of the per-process call COUNT (never of the local stop
+  flag), and both of its loop call sites (``TrainStep.run``,
+  ``Estimator.fit``) poll it exactly once per step on every rank;
+  kvstore fusion plans are a deterministic function of the push-order
+  (key, shape, dtype) signature, identical on every peer (PR 4).
 """
 from __future__ import annotations
 

@@ -437,7 +437,10 @@ class TrainStep:
         if losses:
             import numpy as _np
 
-            _np.asarray(losses[-1])  # surface step errors inside run()
+            # ONE deliberate end-of-run sync so step errors surface
+            # inside run(), not at the caller's first read:
+            # mxtpu: noqa[MXT010]
+            _np.asarray(losses[-1])
         return losses
 
     def write_back(self):

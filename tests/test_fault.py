@@ -68,9 +68,9 @@ def test_env_spec_reaches_check(monkeypatch):
 
 def test_unknown_seam_rejected():
     with pytest.raises(MXNetError, match="unknown fault seam"):
-        fault.check("nosuch.seam")
+        fault.check("nosuch.seam")  # mxtpu: noqa[MXT040] negative test
     with pytest.raises(MXNetError, match="unknown fault seam"):
-        with fault.inject("nosuch.seam"):
+        with fault.inject("nosuch.seam"):  # mxtpu: noqa[MXT040] negative test
             pass
 
 

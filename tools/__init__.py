@@ -1,0 +1,1 @@
+# Makes the repo's tooling importable as a package (`python -m tools.check`).

@@ -1,0 +1,20 @@
+"""mxtpu-check: repo-specific static analysis for mxnet-tpu.
+
+``python -m tools.check [roots...]`` runs every registered pass (see
+``tools/check/passes/``) over the given roots (default:
+``mxnet_tpu tests ci``) and fails on any finding that is neither waived
+inline (``# mxtpu: noqa[MXTnnn] <reason>``) nor carried in
+``tools/check/baseline.json``.  README "Static analysis" documents the
+pass catalog.
+"""
+from .core import (Baseline, CheckContext, Finding, ParsedModule, Pass,
+                   all_passes, register, run_checks)
+
+__all__ = ["Baseline", "CheckContext", "Finding", "ParsedModule", "Pass",
+           "all_passes", "register", "run_checks", "main"]
+
+
+def main(argv=None):
+    from .__main__ import main as _main
+
+    return _main(argv)

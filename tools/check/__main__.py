@@ -1,0 +1,125 @@
+"""CLI for mxtpu-check.
+
+Exit status: 0 = clean (or baselined/waived only), 1 = new findings,
+2 = usage/parse errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import Baseline, all_passes, run_checks
+
+DEFAULT_ROOTS = ("mxnet_tpu", "tests", "ci")
+
+
+def _find_repo_root(start):
+    """Walk up from ``start`` to the directory holding mxnet_tpu/env.py."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.exists(os.path.join(cur, "mxnet_tpu", "env.py")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.check",
+        description="repo-specific static analysis (SPMD collective "
+                    "safety, hot-path host syncs, lock/thread hygiene, "
+                    "env-knob registry, fault-seam integrity)")
+    ap.add_argument("roots", nargs="*", default=list(DEFAULT_ROOTS),
+                    help="files/directories to scan (default: %(default)s)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected from cwd)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: tools/check/"
+                         "baseline.json under the repo root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write current findings into the baseline "
+                         "(reasons marked TODO — fill them in)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated pass names or MXT codes to run")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for name, cls in sorted(all_passes().items()):
+            print(f"{name}:")
+            for code, title in sorted(cls.codes.items()):
+                print(f"  {code}  {title}")
+        return 0
+
+    repo_root = args.root or _find_repo_root(os.getcwd())
+    baseline_path = args.baseline or os.path.join(
+        repo_root, "tools", "check", "baseline.json")
+    select = set(args.select.split(",")) if args.select else None
+
+    findings, errors = run_checks(repo_root, args.roots, select=select)
+
+    baseline = Baseline() if args.no_baseline else Baseline.load(
+        baseline_path)
+    new, suppressed, unused = baseline.filter(findings)
+
+    if args.update_baseline:
+        dropped = 0
+        if select is None:
+            # a full run proves these entries match nothing — prune
+            # them so a stale entry can never mask a future finding
+            unused_ids = {id(e) for e in unused}
+            baseline.entries = [e for e in baseline.entries
+                                if id(e) not in unused_ids]
+            dropped = len(unused_ids)
+        for f in new:
+            baseline.entries.append(Baseline.entry_for(
+                f, "TODO: justify or fix"))
+        baseline.save(baseline_path)
+        print(f"baseline: +{len(new)} entries, -{dropped} stale -> "
+              f"{baseline_path}")
+        for e in errors:
+            print(f"error: {e}", file=sys.stderr)
+        return 1 if errors else 0
+
+    if select is None and not args.no_baseline:
+        # stale entries fail the gate: a fixed finding must be deleted
+        # from the baseline or it would suppress the NEXT real finding
+        # with the same code+path+scope+key (--update-baseline prunes)
+        for e in unused:
+            errors.append(
+                f"baseline entry never matched — delete it or fix the "
+                f"regression: {e.get('code')} {e.get('path')} "
+                f"{e.get('scope')} {e.get('key')}")
+
+    for e in errors:
+        print(f"error: {e}", file=sys.stderr)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in new],
+            "suppressed": len(suppressed),
+            "errors": errors}, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        tail = f"{len(new)} finding(s)"
+        if suppressed:
+            tail += f", {len(suppressed)} baselined"
+        print(("FAIL: " if new else "OK: ") + tail)
+    return 1 if new or errors else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `... | head` closed stdout; swallow the write at shutdown too
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,51 @@
+"""Small AST helpers shared by the passes."""
+from __future__ import annotations
+
+import ast
+
+
+def dotted(node):
+    """Best-effort dotted-name string for a Name/Attribute chain
+    (``jax.process_index`` -> "jax.process_index"); None otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call):
+    """Dotted name of a Call's callee (None for computed callees)."""
+    return dotted(call.func) if isinstance(call, ast.Call) else None
+
+
+def names_in(node):
+    """All bare identifiers + attribute tails in a subtree (lowercased),
+    plus exact string constants — the soup rank/uniform classifiers
+    match against."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id.lower())
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr.lower())
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.add(sub.value)
+    return out
+
+
+def terminates(stmts):
+    """True when a statement list always leaves the enclosing block
+    (ends in return/raise/continue/break)."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def iter_functions(tree):
+    """Yield every (Async)FunctionDef in the module."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

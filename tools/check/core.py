@@ -1,0 +1,320 @@
+"""mxtpu-check core: findings, noqa waivers, baseline, and the runner.
+
+The repo's SPMD/concurrency/hot-path contracts (CHANGES.md PRs 1-5) are
+machine-enforced here instead of living only in reviewers' memories.  A
+*pass* is an AST visitor over one parsed module (plus an optional
+cross-file ``finalize``); it emits :class:`Finding` objects with a stable
+``MXTnnn`` code.  The gate is "zero NEW findings":
+
+- inline waiver: ``# mxtpu: noqa[MXT001] <reason>`` on the flagged line
+  (or on a comment line directly above it);
+- baseline file (``tools/check/baseline.json``): known findings carried
+  with a written reason, matched by (code, path, scope, key) so line
+  drift does not invalidate them.
+
+Run ``python -m tools.check mxnet_tpu tests ci`` from the repo root.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+
+_NOQA_RE = re.compile(r"mxtpu:\s*noqa\[([A-Z0-9,\s]+)\]")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation.
+
+    ``scope`` is the enclosing function qualname (``<module>`` at top
+    level) and ``key`` a line-number-free detail string; together with
+    ``code`` and ``path`` they form the baseline fingerprint, so a
+    baselined finding survives unrelated edits that shift line numbers.
+    ``col`` distinguishes two violations on the SAME line (both are
+    real) from one AST node reported twice; it is deliberately NOT part
+    of the baseline fingerprint.
+    """
+
+    code: str
+    path: str          # repo-relative, '/'-separated
+    line: int
+    message: str
+    hint: str = ""
+    scope: str = "<module>"
+    key: str = ""
+    col: int = 0
+
+    @property
+    def fingerprint(self):
+        return (self.code, self.path, self.scope, self.key or self.message)
+
+    def render(self):
+        out = f"{self.path}:{self.line}: {self.code} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+class ParsedModule:
+    """A source file parsed once and shared by every pass."""
+
+    def __init__(self, abspath, relpath, source):
+        self.abspath = abspath
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self._qualnames = None
+
+    def qualname(self, node):
+        """Enclosing function qualname for a node (``<module>`` if none)."""
+        if self._qualnames is None:
+            self._qualnames = {}
+            self._walk_scopes(self.tree, [])
+        best = "<module>"
+        best_span = None
+        for (lo, hi), name in self._qualnames.items():
+            if lo <= node.lineno <= hi:
+                if best_span is None or (lo >= best_span[0]
+                                         and hi <= best_span[1]):
+                    best, best_span = name, (lo, hi)
+        return best
+
+    def _walk_scopes(self, node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = ".".join(stack + [child.name])
+                if not isinstance(child, ast.ClassDef):
+                    hi = max((n.lineno for n in ast.walk(child)
+                              if hasattr(n, "lineno")), default=child.lineno)
+                    self._qualnames[(child.lineno, hi)] = qual
+                self._walk_scopes(child, stack + [child.name])
+            else:
+                self._walk_scopes(child, stack)
+
+    def noqa_codes(self, line):
+        """Waiver codes covering ``line``: an inline ``# mxtpu: noqa[...]``
+        on the line itself or a standalone comment directly above."""
+        codes = set()
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                text = self.lines[ln - 1]
+                if ln != line and not text.lstrip().startswith("#"):
+                    continue
+                m = _NOQA_RE.search(text)
+                if m:
+                    codes.update(c.strip() for c in m.group(1).split(","))
+        return codes
+
+
+# -- pass registry ---------------------------------------------------------
+_REGISTRY = {}
+
+
+def register(cls):
+    """Class decorator: adds a pass to the registry keyed on its name."""
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_passes():
+    from . import passes  # noqa: F401  (imports register the builtins)
+
+    return dict(_REGISTRY)
+
+
+class Pass:
+    """Base pass.  Subclasses set ``name``, ``codes`` (dict code->title)
+    and implement ``run(ctx, mod) -> list[Finding]``; cross-file passes
+    may also implement ``finalize(ctx) -> list[Finding]``."""
+
+    name = ""
+    codes: dict = {}
+
+    def run(self, ctx, mod):  # pragma: no cover - interface
+        return []
+
+    def finalize(self, ctx):
+        return []
+
+
+# -- baseline --------------------------------------------------------------
+class Baseline:
+    """Multiset of known findings, each carried with a reason.
+
+    File format: ``{"findings": [{"code", "path", "scope", "key",
+    "reason"}, ...]}``.  Matching consumes entries, so N baselined
+    findings suppress at most N occurrences.
+    """
+
+    def __init__(self, entries=None):
+        self.entries = list(entries or [])
+
+    @classmethod
+    def load(cls, path):
+        if not path or not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            data = json.load(f)
+        return cls(data.get("findings", []))
+
+    def save(self, path):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"findings": self.entries}, f, indent=2,
+                      sort_keys=False)
+            f.write("\n")
+
+    def filter(self, findings):
+        """Split findings into (new, suppressed, unused); consumes
+        matches.  ``unused`` is the baseline entries that matched
+        nothing — a fixed finding must be DELETED from the baseline,
+        or its stale entry would suppress the next real finding with
+        the same fingerprint."""
+        pool = {}
+        for e in self.entries:
+            fp = (e.get("code"), e.get("path"), e.get("scope"),
+                  e.get("key"))
+            pool[fp] = pool.get(fp, 0) + 1
+        new, suppressed = [], []
+        for f in findings:
+            if pool.get(f.fingerprint, 0) > 0:
+                pool[f.fingerprint] -= 1
+                suppressed.append(f)
+            else:
+                new.append(f)
+        unused = []
+        for e in reversed(self.entries):
+            fp = (e.get("code"), e.get("path"), e.get("scope"),
+                  e.get("key"))
+            if pool.get(fp, 0) > 0:
+                pool[fp] -= 1
+                unused.append(e)
+        unused.reverse()
+        return new, suppressed, unused
+
+    @staticmethod
+    def entry_for(finding, reason):
+        code, path, scope, key = finding.fingerprint
+        return {"code": code, "path": path, "scope": scope, "key": key,
+                "reason": reason}
+
+
+# -- runner ----------------------------------------------------------------
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules",
+              ".ipynb_checkpoints"}
+
+
+def iter_source_files(roots, repo_root, suffixes=(".py",)):
+    """Yield (abspath, relpath) under ``roots`` (files or directories),
+    sorted for deterministic output."""
+    seen = set()
+    out = []
+    for root in roots:
+        root = os.path.join(repo_root, root) if not os.path.isabs(root) \
+            else root
+        if os.path.isfile(root):
+            cand = [root]
+        else:
+            cand = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for fn in sorted(filenames):
+                    cand.append(os.path.join(dirpath, fn))
+        for path in cand:
+            if not path.endswith(tuple(suffixes)):
+                continue
+            ap = os.path.abspath(path)
+            if ap in seen:
+                continue
+            seen.add(ap)
+            rel = os.path.relpath(ap, repo_root).replace(os.sep, "/")
+            out.append((ap, rel))
+    return out
+
+
+class CheckContext:
+    """Shared state for one checker run: repo model + scanned roots."""
+
+    def __init__(self, repo_root, roots):
+        from .repo import RepoModel
+
+        self.repo_root = os.path.abspath(repo_root)
+        self.roots = list(roots)
+        self.repo = RepoModel(self.repo_root)
+        self.modules = []          # ParsedModule list, filled by run_checks
+        self.text_files = []       # (abspath, relpath) for .sh/.yml scans
+
+
+def run_checks(repo_root, roots, select=None):
+    """Run every registered pass over ``roots``.
+
+    Returns ``(findings, errors)`` — findings already filtered through
+    inline noqa waivers (waived ones dropped), NOT yet through the
+    baseline.  ``errors`` are files that failed to parse (reported, never
+    silently skipped).
+    """
+    ctx = CheckContext(repo_root, roots)
+    findings, errors = [], []
+    for root in ctx.roots:
+        rp = root if os.path.isabs(root) else \
+            os.path.join(ctx.repo_root, root)
+        if not os.path.exists(rp):
+            # a typo'd/renamed root must FAIL the gate, not silently
+            # scan nothing and report the tree clean
+            errors.append(f"{root}: no such file or directory "
+                          f"(root not scanned)")
+    passes = [cls() for name, cls in sorted(all_passes().items())
+              if select is None or name in select
+              or any(c in select for c in cls.codes)]
+    mods = {}
+    for ap, rel in iter_source_files(roots, ctx.repo_root):
+        try:
+            with open(ap, encoding="utf-8") as f:
+                src = f.read()
+            mods[rel] = ParsedModule(ap, rel, src)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(f"{rel}: parse error: {e}")
+    ctx.modules = list(mods.values())
+    ctx.text_files = iter_source_files(roots, ctx.repo_root,
+                                       suffixes=(".sh", ".yml", ".yaml"))
+    for p in passes:
+        for mod in ctx.modules:
+            findings.extend(p.run(ctx, mod))
+        findings.extend(p.finalize(ctx))
+    text_lines = {}
+    for ap, rel in ctx.text_files:
+        try:
+            with open(ap, encoding="utf-8") as f:
+                text_lines[rel] = f.read().splitlines()
+        except OSError:
+            pass
+    kept, seen = [], set()
+    for f in findings:
+        mod = mods.get(f.path)
+        if mod is not None and f.code in mod.noqa_codes(f.line):
+            continue
+        if mod is None and f.path in text_lines:
+            # non-Python findings (MXT040 in .sh/.yml) honor the same
+            # inline waiver: on the flagged line or the line above
+            lines = text_lines[f.path]
+            window = [lines[i] for i in (f.line - 1, f.line - 2)
+                      if 0 <= i < len(lines)]
+            if any(f.code in (m.group(1) if (m := _NOQA_RE.search(t))
+                              else "") for t in window):
+                continue
+        # a ternary collective is reachable both via its IfExp handler
+        # and the generic call walk — report each NODE once (col keeps
+        # two distinct same-line violations distinct)
+        fp = (f.code, f.path, f.line, f.col, f.scope, f.key)
+        if fp in seen:
+            continue
+        seen.add(fp)
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.code))
+    return kept, errors

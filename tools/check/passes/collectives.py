@@ -1,0 +1,273 @@
+"""MXT001-003: SPMD collective-safety.
+
+The contract (earned in PR 2's retry-policy postmortem and PR 5's
+check_stop hardening, documented in ``parallel/collectives.py``): every
+SPMD peer must issue the SAME collectives in the SAME program order.  A
+collective that only some ranks reach — because it sits under a
+rank-conditional branch, inside an ``except`` handler, or inside a
+unilateral retry wrapper — hangs or desyncs the mesh.
+
+- **MXT001** — collective reached under a rank-conditional branch
+  (``jax.process_index()``, ``kv.rank``, ``MXNET_WORKER_ID``-family env
+  reads, launcher-rank helpers, or a local flag assigned from one).
+  Conditions that are *uniform* across ranks (``process_count()``,
+  ``_testing_force``) are exempt: every rank takes the same arm.
+- **MXT002** — collective issued inside an ``except`` handler or passed
+  to a retry wrapper (``call_with_retries``): a lone re-issue desyncs
+  the peers' collective call counts (PR 2: "no unilateral retry of a
+  collective").
+- **MXT003** — collective call counts differ across the arms of a
+  branch whose condition is neither provably uniform nor
+  rank-conditional (the equal-call-count contract): if the condition
+  CAN diverge across ranks, so do the collective counts.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, dotted, names_in, terminates
+from ..core import Finding, Pass, register
+
+# names that issue (or transitively issue) a mesh collective
+COLLECTIVE_NAMES = {
+    "psum", "pmean", "all_gather", "reduce_scatter", "ppermute",
+    "all_to_all", "allreduce_hosts", "allreduce_hosts_quantized",
+    "allreduce_hosts_quantized_multi", "allreduce_any", "barrier",
+    "_barrier", "sync_global_devices", "_allreduce_bucketed",
+}
+# kvstore transport methods count when called on something kvstore-ish
+_KV_METHODS = {"push", "pull", "pushpull", "row_sparse_pull"}
+_KV_RECEIVERS = {"kv", "_kv", "kvstore", "_kvstore", "store", "_store"}
+
+# condition vocabulary
+_RANK_MARKERS = {"process_index", "worker_id", "launcher_rank",
+                 "_launcher_rank", "rank", "primary", "_primary",
+                 "is_primary", "MXNET_WORKER_ID", "DMLC_WORKER_ID",
+                 "TPU_WORKER_ID"}
+_UNIFORM_MARKERS = {"process_count", "_testing_force", "device_count",
+                    "local_device_count", "is_initialized"}
+_RETRY_WRAPPERS = {"call_with_retries", "retry", "with_retries"}
+
+
+def _is_collective(call):
+    name = call_name(call)
+    if name is None:
+        return False
+    tail = name.rsplit(".", 1)[-1]
+    if tail in COLLECTIVE_NAMES:
+        return True
+    if tail in _KV_METHODS and isinstance(call.func, ast.Attribute):
+        recv = dotted(call.func.value)
+        if recv and recv.rsplit(".", 1)[-1] in _KV_RECEIVERS:
+            return True
+    return False
+
+
+def _classify(test, rank_locals):
+    """'rank' | 'uniform' | 'unknown' for a branch condition."""
+    names = names_in(test)
+    lowered = {n.lower() for n in _RANK_MARKERS}
+    if names & lowered or names & _RANK_MARKERS or \
+            names & {n.lower() for n in rank_locals}:
+        return "rank"
+    if names & _UNIFORM_MARKERS:
+        return "uniform"
+    return "unknown"
+
+
+def _rank_locals(fn):
+    """Names assigned from a rank-valued expression inside ``fn``
+    (``primary = jax.process_index() == 0`` taints ``primary``)."""
+    tainted = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and \
+                _classify(node.value, tainted) == "rank":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    tainted.add(tgt.id)
+    return tainted
+
+
+def _walk_same_scope(node):
+    """ast.walk that does NOT descend into nested function/lambda
+    definitions — defining a closure issues nothing; its body is
+    analyzed when (if) it runs, as its own scope."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _collectives_in(stmts):
+    out = []
+    for stmt in stmts:
+        for sub in _walk_same_scope(stmt):
+            if isinstance(sub, ast.Call) and _is_collective(sub):
+                out.append(sub)
+    return out
+
+
+@register
+class CollectiveSafety(Pass):
+    name = "collective-safety"
+    codes = {
+        "MXT001": "collective under a rank-conditional branch",
+        "MXT002": "collective inside except handler / retry wrapper",
+        "MXT003": "collective call-count imbalance across branch arms",
+    }
+
+    def run(self, ctx, mod):
+        findings = []
+        tree = mod.tree
+
+        def emit(code, node, msg, hint, key):
+            findings.append(Finding(
+                code=code, path=mod.relpath, line=node.lineno,
+                message=msg, hint=hint, scope=mod.qualname(node), key=key,
+                col=getattr(node, "col_offset", 0)))
+
+        def scan_block(stmts, rank_depth, except_depth, rank_locals):
+            """Walk statements tracking rank-conditional and except
+            nesting; also apply guard-style taint (a rank-conditional
+            early return makes the REST of the block rank-conditional)."""
+            guard_tainted = rank_depth
+            for stmt in stmts:
+                self._scan_stmt(stmt, guard_tainted, except_depth,
+                                rank_locals, emit, scan_block)
+                if isinstance(stmt, ast.If) and \
+                        _classify(stmt.test, rank_locals) == "rank" and \
+                        terminates(stmt.body) and not stmt.orelse:
+                    guard_tainted += 1
+
+        scan_block(tree.body, 0, 0, set())
+        for fn in ast.walk(tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_block(fn.body, 0, 0, _rank_locals(fn))
+        return findings
+
+    def _scan_stmt(self, stmt, rank_depth, except_depth, rank_locals,
+                   emit, scan_block):
+        # direct collective calls at this nesting level
+        own_subtrees = []
+        if isinstance(stmt, ast.If):
+            cls = _classify(stmt.test, rank_locals)
+            arm_rank = rank_depth + (1 if cls == "rank" else 0)
+            scan_block(stmt.body, arm_rank, except_depth, rank_locals)
+            scan_block(stmt.orelse, arm_rank, except_depth, rank_locals)
+            if cls == "unknown":
+                n_body = len(_collectives_in(stmt.body))
+                n_else = len(_collectives_in(stmt.orelse))
+                if n_body != n_else and max(n_body, n_else) > 0:
+                    emit("MXT003", stmt,
+                         f"collective call count differs across branch "
+                         f"arms ({n_body} vs {n_else}) under a condition "
+                         f"not provably uniform across ranks",
+                         "every SPMD peer must issue the same collectives "
+                         "in the same order; hoist the collective out of "
+                         "the branch or derive the condition from "
+                         "rank-uniform state (see "
+                         "parallel/collectives.py docstring)",
+                         key=f"if-imbalance:{n_body}v{n_else}")
+            # collective IN the test expression itself
+            for sub in ast.walk(stmt.test):
+                if isinstance(sub, ast.Call) and _is_collective(sub):
+                    self._emit_ctx(sub, rank_depth, except_depth, emit)
+            return
+        if isinstance(stmt, ast.Try):
+            scan_block(stmt.body, rank_depth, except_depth, rank_locals)
+            for h in stmt.handlers:
+                scan_block(h.body, rank_depth, except_depth + 1,
+                           rank_locals)
+            scan_block(stmt.orelse, rank_depth, except_depth, rank_locals)
+            scan_block(stmt.finalbody, rank_depth, except_depth,
+                       rank_locals)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            scan_block(stmt.body, rank_depth, except_depth, rank_locals)
+            scan_block(stmt.orelse, rank_depth, except_depth, rank_locals)
+            own_subtrees = [stmt.iter] if hasattr(stmt, "iter") else \
+                [stmt.test]
+        elif isinstance(stmt, ast.With):
+            scan_block(stmt.body, rank_depth, except_depth, rank_locals)
+            own_subtrees = [i.context_expr for i in stmt.items]
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            return  # nested scopes are scanned as their own functions
+        else:
+            own_subtrees = [stmt]
+        for sub_tree in own_subtrees:
+            for sub in _walk_same_scope(sub_tree):
+                if isinstance(sub, ast.IfExp):
+                    # ternaries branch exactly like If statements
+                    cls = _classify(sub.test, rank_locals)
+                    arm_calls = [c for arm in (sub.body, sub.orelse)
+                                 for c in _collectives_in([arm])]
+                    if cls == "rank":
+                        for c in arm_calls:
+                            self._emit_ctx(c, rank_depth + 1,
+                                           except_depth, emit)
+                    elif cls == "unknown" and arm_calls:
+                        n_body = len(_collectives_in([sub.body]))
+                        n_else = len(_collectives_in([sub.orelse]))
+                        if n_body != n_else:
+                            emit("MXT003", sub,
+                                 f"collective call count differs across "
+                                 f"ternary arms ({n_body} vs {n_else}) "
+                                 f"under a condition not provably "
+                                 f"uniform across ranks",
+                                 "every SPMD peer must issue the same "
+                                 "collectives in the same order (see "
+                                 "parallel/collectives.py docstring)",
+                                 key=f"if-imbalance:{n_body}v{n_else}")
+                elif isinstance(sub, ast.Call):
+                    if _is_collective(sub):
+                        self._emit_ctx(sub, rank_depth, except_depth, emit)
+                    else:
+                        name = call_name(sub)
+                        tail = (name or "").rsplit(".", 1)[-1]
+                        if tail in _RETRY_WRAPPERS:
+                            self._check_retry_args(sub, tail, emit)
+
+    def _check_retry_args(self, call, wrapper, emit):
+        """MXT002 for a collective handed to a retry wrapper — as a
+        direct name OR wrapped in a lambda closing over arguments."""
+        hint = ("a unilateral retry re-issues the collective on one "
+                "rank only and desyncs SPMD call counts; escalate to a "
+                "whole-job restart instead (PR 2 contract)")
+        for arg in call.args:
+            aname = dotted(arg)
+            if aname and aname.rsplit(".", 1)[-1] in COLLECTIVE_NAMES:
+                emit("MXT002", call,
+                     f"collective {aname!r} passed to retry wrapper "
+                     f"{wrapper!r}", hint, key=f"retry:{aname}")
+            elif isinstance(arg, ast.Lambda):
+                for sub in ast.walk(arg.body):
+                    if isinstance(sub, ast.Call) and _is_collective(sub):
+                        cname = call_name(sub) or "<collective>"
+                        emit("MXT002", sub,
+                             f"collective {cname!r} issued from a lambda "
+                             f"passed to retry wrapper {wrapper!r}",
+                             hint, key=f"retry:lambda:{cname}")
+
+    def _emit_ctx(self, call, rank_depth, except_depth, emit):
+        name = call_name(call) or "<collective>"
+        if except_depth > 0:
+            emit("MXT002", call,
+                 f"collective {name!r} issued inside an except handler",
+                 "an error path runs on SOME ranks only — peers never "
+                 "issue the matching collective and the mesh hangs; "
+                 "escalate to a whole-job restart (PR 2 contract)",
+                 key=f"except:{name}")
+        if rank_depth > 0:
+            emit("MXT001", call,
+                 f"collective {name!r} reached under a rank-conditional "
+                 f"branch",
+                 "every SPMD peer must issue it or none may; hoist it "
+                 "above the rank branch (uniform process_count() guards "
+                 "are fine — see parallel/collectives.py docstring)",
+                 key=f"rank-cond:{name}")

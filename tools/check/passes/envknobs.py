@@ -1,0 +1,157 @@
+"""MXT030-032: the MXNET_* knob registry must stay closed.
+
+``mxnet_tpu/env.py`` is the single registry: every knob the library
+reads is declared there (describe()'s wired table or ``_SUBSUMED``) and
+documented in README's knob tables — so ``mx.env.describe()`` is always
+the complete operator surface and a typo'd var can never silently do
+nothing.
+
+- **MXT030** — a ``MXNET_*`` var read inside ``mxnet_tpu/`` that env.py
+  does not declare.
+- **MXT031** — a wired knob declared in env.py that nothing reads
+  anywhere in the repo (dead registry entry or a lost call site).
+- **MXT032** — a wired knob missing from README's knob tables.
+
+Read shapes recognized: ``os.environ.get/[]``, ``os.getenv``,
+``environ.get``, and the ``env.get_str/get_int/get_bool/get_float``
+helpers — with a literal name argument.  Reads through a variable
+(checkpoint's launcher-rank probe loops over a name tuple) are not
+resolved; the registry direction (MXT031) covers those via the
+repo-wide text sweep.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..astutil import call_name
+from ..core import Finding, Pass, register
+
+_MXNET_NAME = re.compile(r"^MXNET_[A-Z0-9_]+$")
+_READ_CALLS = {"os.environ.get", "environ.get", "os.getenv", "getenv",
+               "env.get_str", "env.get_int", "env.get_bool",
+               "env.get_float", "_env.get_str", "_env.get_int",
+               "_env.get_bool", "_env.get_float", "get_str", "get_int",
+               "get_bool", "get_float"}
+
+
+def _read_names(node):
+    """MXNET_* names read by this Call/Subscript node, if any."""
+    names = []
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in _READ_CALLS or (
+                name and name.endswith((".environ.get", ".getenv"))):
+            for arg in node.args[:1] or []:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str) and \
+                            _MXNET_NAME.match(sub.value):
+                        names.append(sub.value)
+    elif isinstance(node, ast.Subscript):
+        from ..astutil import dotted
+
+        base = dotted(node.value)
+        if base and base.endswith("environ"):
+            for sub in ast.walk(node.slice):
+                if isinstance(sub, ast.Constant) and \
+                        isinstance(sub.value, str) and \
+                        _MXNET_NAME.match(sub.value):
+                    names.append(sub.value)
+    return names
+
+
+@register
+class EnvKnobRegistry(Pass):
+    name = "env-knob-registry"
+    codes = {
+        "MXT030": "MXNET_* read not registered in env.py",
+        "MXT031": "registered knob never read anywhere",
+        "MXT032": "registered knob missing from README knob tables",
+    }
+
+    def __init__(self):
+        self._reads = {}   # name -> first (path, line, scope)
+
+    def run(self, ctx, mod):
+        findings = []
+        registry = ctx.repo.env_registry
+        is_env_py = mod.relpath == registry["path"]
+        in_lib = mod.relpath.startswith("mxnet_tpu/")
+        for node in ast.walk(mod.tree):
+            for name in _read_names(node):
+                self._reads.setdefault(
+                    name, (mod.relpath, node.lineno, mod.qualname(node)))
+                if in_lib and not is_env_py and \
+                        name not in registry["declared"]:
+                    findings.append(Finding(
+                        code="MXT030", path=mod.relpath, line=node.lineno,
+                        message=f"{name} is read here but not registered "
+                                f"in {registry['path']}",
+                        hint="add it to env.py's describe() wired table "
+                             "(+ docstring) and README's knob table so "
+                             "describe() stays the complete operator "
+                             "surface",
+                        scope=mod.qualname(node), key=f"unregistered:{name}"))
+        return findings
+
+    def finalize(self, ctx):
+        findings = []
+        registry = ctx.repo.env_registry
+        anchors = registry["anchors"]
+        # vars whose READ legitimately lives outside the scanned roots
+        # (bench.py at the repo root) are resolved by a repo-wide text
+        # sweep before MXT031 fires
+        unread = {n for n in registry["wired"] if n not in self._reads}
+        if unread:
+            unread -= _textual_reads(ctx.repo_root, unread,
+                                     exclude=(registry["path"],
+                                              "README.md"))
+        for name in sorted(unread):
+            findings.append(Finding(
+                code="MXT031", path=registry["path"],
+                line=anchors.get(name, 1),
+                message=f"{name} is registered in env.py but nothing "
+                        f"reads it",
+                hint="wire it to a call site or delete the registry row "
+                     "(a dead knob row misdocuments the operator surface)",
+                scope="describe", key=f"unread:{name}"))
+        for name in sorted(registry["wired"] - ctx.repo.readme_knobs):
+            findings.append(Finding(
+                code="MXT032", path=registry["path"],
+                line=anchors.get(name, 1),
+                message=f"{name} is registered in env.py but missing "
+                        f"from README's knob tables",
+                hint="add a row to README's knob reference (operators "
+                     "read the README, not env.py)",
+                scope="describe", key=f"undocumented:{name}"))
+        return findings
+
+
+def _textual_reads(repo_root, names, exclude=()):
+    """Names that appear in any repo .py file outside ``exclude`` —
+    the cheap fallback for read sites outside the scanned roots."""
+    found = set()
+    for dirpath, dirnames, filenames in os.walk(repo_root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn),
+                                  repo_root).replace(os.sep, "/")
+            if rel in exclude:
+                continue
+            try:
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            for n in names - found:
+                if n in text:
+                    found.add(n)
+        if found == set(names):
+            break
+    return found

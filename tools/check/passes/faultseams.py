@@ -1,0 +1,160 @@
+"""MXT040: fault-seam names must exist in the fault registry.
+
+``mxnet_tpu/fault.py``'s ``SEAMS`` tuple is the registry; a chaos test
+or CI script arming ``some.seam`` that fault.py never checks silently
+tests nothing (``_parse_spec`` warns and skips unknown entries — a
+drifted seam name turns a chaos lane green without exercising the
+failure path).  Checked sites:
+
+- ``fault.inject("...")`` / ``fault.check("...")`` /
+  ``fault.guard("...")`` / ``call_with_retries("...", fn)`` first-arg
+  literals in Python sources;
+- ``MXNET_FAULT_SPEC`` values — monkeypatch/env-dict/assignment string
+  literals in Python, and ``MXNET_FAULT_SPEC=...`` assignments in
+  ``ci/*.sh`` / ``*.yml`` (scanned textually).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import call_name
+from ..core import Finding, Pass, register
+
+_SEAM_CALLS = {"inject", "check", "guard", "call_with_retries"}
+_FAULT_MODULES = {"fault", "_fault"}
+_SPEC_SH_RE = re.compile(r"MXNET_FAULT_SPEC=[\"']?([^\"'\s]+)")
+
+
+def _fault_receivers(tree):
+    """Local names bound to the fault module in this file — ``fault``/
+    ``_fault`` plus any import alias (``from mxnet_tpu import fault as
+    flt``, ``import mxnet_tpu.fault as mf``), so an aliased
+    ``flt.inject("drifted.seam")`` cannot evade MXT040."""
+    recv = set(_FAULT_MODULES)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname and a.name.rsplit(".", 1)[-1] in \
+                        _FAULT_MODULES:
+                    recv.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.asname and a.name in _FAULT_MODULES:
+                    recv.add(a.asname)
+    return recv
+
+
+def _spec_seams(spec):
+    """Seam names from a ``seam:mode[:...]`` comma-separated spec."""
+    out = []
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if entry and ":" in entry:
+            out.append(entry.split(":", 1)[0])
+    return out
+
+
+@register
+class FaultSeamIntegrity(Pass):
+    name = "fault-seam-integrity"
+    codes = {"MXT040": "unknown fault-seam name"}
+
+    def _finding(self, path, line, seam, ctx, scope="<module>"):
+        known = ", ".join(sorted(ctx.repo.fault_seams))
+        return Finding(
+            code="MXT040", path=path, line=line,
+            message=f"fault seam {seam!r} is not in fault.SEAMS",
+            hint=f"a drifted seam name arms nothing and the chaos lane "
+                 f"goes green without testing the failure path; known "
+                 f"seams: {known}",
+            scope=scope, key=f"seam:{seam}")
+
+    def run(self, ctx, mod):
+        seams = ctx.repo.fault_seams
+        if not seams:
+            return []
+        findings = []
+        receivers = _fault_receivers(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            tail = name.rsplit(".", 1)[-1]
+            if tail in _SEAM_CALLS and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                # qualified check()/guard()/inject() only count on the
+                # fault module or an alias of it (env.get/fault.check
+                # both end in 'check'); the receiver's LAST segment
+                # matches too (mxnet_tpu.fault.inject)
+                if "." in name and tail != "call_with_retries":
+                    recv = name.rsplit(".", 1)[0]
+                    if recv not in receivers and \
+                            recv.rsplit(".", 1)[-1] not in _FAULT_MODULES:
+                        continue
+                seam = node.args[0].value
+                if "." in seam and seam not in seams:
+                    findings.append(self._finding(
+                        mod.relpath, node.lineno, seam, ctx,
+                        mod.qualname(node)))
+        # MXNET_FAULT_SPEC string values (setenv / environ[...] / dicts)
+        for node in ast.walk(mod.tree):
+            specs = _spec_values(node)
+            for lineno, spec in specs:
+                for seam in _spec_seams(spec):
+                    if seam not in seams:
+                        findings.append(self._finding(
+                            mod.relpath, lineno, seam, ctx,
+                            mod.qualname(node)))
+        return findings
+
+    def finalize(self, ctx):
+        seams = ctx.repo.fault_seams
+        if not seams:
+            return []
+        findings = []
+        for ap, rel in ctx.text_files:
+            try:
+                with open(ap, encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                continue
+            for i, line in enumerate(lines, 1):
+                for m in _SPEC_SH_RE.finditer(line):
+                    for seam in _spec_seams(m.group(1)):
+                        if seam not in seams:
+                            findings.append(self._finding(rel, i, seam,
+                                                          ctx))
+        return findings
+
+
+def _spec_values(node):
+    """(line, spec-string) pairs associated with MXNET_FAULT_SPEC in
+    this node: setenv()/environ[...] assignments and dict literals."""
+    out = []
+    if isinstance(node, ast.Call):
+        args = list(node.args)
+        for i, arg in enumerate(args[:-1]):
+            if isinstance(arg, ast.Constant) and \
+                    arg.value == "MXNET_FAULT_SPEC" and \
+                    isinstance(args[i + 1], ast.Constant) and \
+                    isinstance(args[i + 1].value, str):
+                out.append((args[i + 1].lineno, args[i + 1].value))
+    elif isinstance(node, ast.Assign):
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Subscript):
+            for sub in ast.walk(tgt.slice):
+                if isinstance(sub, ast.Constant) and \
+                        sub.value == "MXNET_FAULT_SPEC" and \
+                        isinstance(node.value, ast.Constant) and \
+                        isinstance(node.value.value, str):
+                    out.append((node.value.lineno, node.value.value))
+    elif isinstance(node, ast.Dict):
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and \
+                    k.value == "MXNET_FAULT_SPEC" and \
+                    isinstance(v, ast.Constant) and \
+                    isinstance(v.value, str):
+                out.append((v.lineno, v.value))
+    return out

@@ -1,0 +1,80 @@
+"""MXT010: blocking host sync on a step hot path.
+
+The class of bug PR 5's fused ``has_overflow`` fixed: a per-param
+``bool(jnp.isfinite(v).all())`` loop paid K blocking device->host round
+trips on every AMP step.  Device values must stay lazily dispatched on
+the hot path; the ONE sync a step needs should be explicit and waived
+with a reason (``# mxtpu: noqa[MXT010] <why this sync is the design>``).
+
+Hot zones are the dispatch/TrainStep/Trainer/bucketing files below —
+whole files, because their every function sits inside the step loop.
+Flagged shapes:
+
+- ``<expr>.item()`` / ``<expr>.asnumpy()``
+- ``np.asarray(x)`` / ``np.array(x)`` (numpy aliases only — ``jnp.*``
+  stays on device and is fine)
+- ``jax.device_get(x)``
+- ``bool(...)`` / ``int(...)`` / ``float(...)`` wrapping an expression
+  that mentions ``jnp``/``jax`` (forces the value to host)
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, names_in
+from ..core import Finding, Pass, register
+
+HOT_ZONES = (
+    "mxnet_tpu/ndarray/dispatch_cache.py",
+    "mxnet_tpu/parallel/data_parallel.py",
+    "mxnet_tpu/parallel/bucketing.py",
+    "mxnet_tpu/gluon/trainer.py",
+    "mxnet_tpu/contrib/amp/loss_scaler.py",
+    "mxnet_tpu/module/bucketing_module.py",
+)
+
+_NP_ALIASES = {"np", "numpy", "_np", "onp"}
+_SYNC_METHODS = {"item", "asnumpy"}
+_CAST_BUILTINS = {"bool", "int", "float"}
+
+
+@register
+class HostSyncInHotPath(Pass):
+    name = "host-sync-hot-path"
+    codes = {"MXT010": "blocking host sync on a step hot path"}
+
+    def run(self, ctx, mod):
+        if mod.relpath not in HOT_ZONES:
+            return []
+        findings = []
+
+        def emit(node, what):
+            findings.append(Finding(
+                code="MXT010", path=mod.relpath, line=node.lineno,
+                message=f"{what} blocks on a device->host transfer on "
+                        f"the step hot path",
+                hint="keep values lazily dispatched; fuse per-item syncs "
+                     "into one reduction with a single sync (PR 5 "
+                     "has_overflow pattern) or waive with a reason if "
+                     "this sync IS the design",
+                scope=mod.qualname(node), key=f"host-sync:{what}",
+                col=node.col_offset))
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            head, _, tail = name.rpartition(".")
+            if tail in _SYNC_METHODS and head:
+                emit(node, f".{tail}()")
+            elif tail in {"asarray", "array"} and \
+                    head.rsplit(".", 1)[-1] in _NP_ALIASES:
+                emit(node, f"{head}.{tail}()")
+            elif tail == "device_get":
+                emit(node, name + "()")
+            elif name in _CAST_BUILTINS and node.args:
+                if names_in(node.args[0]) & {"jnp", "jax"}:
+                    emit(node, f"{name}() on a device expression")
+        return findings

@@ -1,0 +1,136 @@
+"""MXT020-022: lock-and-thread hygiene.
+
+Three deadlock shapes this repo has already paid for:
+
+- **MXT020** — ``threading.Lock()`` in a module that installs signal
+  handlers.  The handler runs ON the main thread between bytecodes; if
+  it lands while the module holds its own plain lock, re-entering
+  self-deadlocks (the PR 5 lifecycle lesson — use ``RLock``).
+- **MXT021** — a blocking ``.join()`` / collective / ``barrier`` while
+  holding a module lock: every other thread that needs the lock (
+  including the one being joined) deadlocks behind it.
+- **MXT022** — thread teardown that ``join()``\\ s a worker BEFORE
+  setting its stop event (the PR 2 DataLoader shape: a worker blocked
+  on its queue never observes the stop and the join never returns).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, dotted
+from ..core import Finding, Pass, register
+
+_BLOCKING_TAILS = {"join", "barrier", "_barrier", "allreduce_hosts",
+                   "allreduce_any", "psum", "sync_global_devices",
+                   "allreduce_hosts_quantized",
+                   "allreduce_hosts_quantized_multi"}
+_STOPPISH = ("stop", "shutdown", "done", "exit", "quit")
+_THREADISH = ("thread", "worker", "pool", "producer", "consumer",
+              "pending", "writer", "watchdog")
+
+
+def _installs_signal_handlers(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name and name.rsplit(".", 1)[-1] == "signal" and \
+                    "." in (name or ""):
+                return True
+    return False
+
+
+@register
+class LockAndThreadHygiene(Pass):
+    name = "lock-thread-hygiene"
+    codes = {
+        "MXT020": "plain threading.Lock in a signal-handler module",
+        "MXT021": "blocking join/collective while holding a lock",
+        "MXT022": "thread joined before its stop event is set",
+    }
+
+    def run(self, ctx, mod):
+        findings = []
+        tree = mod.tree
+
+        # MXT020 ------------------------------------------------------
+        if _installs_signal_handlers(tree):
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call):
+                    name = call_name(node)
+                    if name and name.rsplit(".", 1)[-1] == "Lock" and \
+                            name.rsplit(".", 1)[0] in ("threading",
+                                                       "_threading"):
+                        findings.append(Finding(
+                            code="MXT020", path=mod.relpath,
+                            line=node.lineno,
+                            message="plain threading.Lock() in a module "
+                                    "that installs signal handlers",
+                            hint="the handler runs on the main thread "
+                                 "between bytecodes — if it re-enters "
+                                 "this module while the lock is held it "
+                                 "self-deadlocks; use threading.RLock() "
+                                 "(PR 5 lifecycle lesson)",
+                            scope=mod.qualname(node), key="plain-lock",
+                            col=node.col_offset))
+
+        # MXT021 ------------------------------------------------------
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.With):
+                continue
+            held = [dotted(i.context_expr) or
+                    (call_name(i.context_expr) or "")
+                    for i in node.items]
+            if not any("lock" in h.lower() for h in held if h):
+                continue
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        name = call_name(sub) or ""
+                        if name.rsplit(".", 1)[-1] in _BLOCKING_TAILS:
+                            findings.append(Finding(
+                                code="MXT021", path=mod.relpath,
+                                line=sub.lineno,
+                                message=f"blocking call {name!r} while "
+                                        f"holding {held[0]!r}",
+                                hint="snapshot state under the lock, "
+                                     "release it, then block — the "
+                                     "joined thread (or any peer) may "
+                                     "need this lock to make progress",
+                                scope=mod.qualname(sub),
+                                key=f"lock-block:{name}",
+                                col=sub.col_offset))
+
+        # MXT022 ------------------------------------------------------
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            joins, sets = [], []
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = call_name(sub)
+                if not name or "." not in name:
+                    continue
+                recv, _, tail = name.rpartition(".")
+                recv_l = recv.lower()
+                if tail == "join" and any(t in recv_l for t in _THREADISH):
+                    joins.append((sub.lineno, recv, sub))
+                if tail == "set" and any(s in recv_l for s in _STOPPISH):
+                    sets.append(sub.lineno)
+            if joins and sets:
+                first_set = min(sets)
+                for lineno, recv, sub in joins:
+                    if lineno < first_set:
+                        findings.append(Finding(
+                            code="MXT022", path=mod.relpath, line=lineno,
+                            message=f"{recv}.join() before the stop "
+                                    f"event is set (first .set() at "
+                                    f"line ~{first_set})",
+                            hint="a worker blocked on its queue never "
+                                 "observes the stop and the join never "
+                                 "returns — set the stop event FIRST, "
+                                 "then join (PR 2 DataLoader deadlock)",
+                            scope=mod.qualname(sub),
+                            key=f"join-before-set:{recv}",
+                            col=sub.col_offset))
+        return findings

@@ -1,0 +1,111 @@
+"""Repo model: the ground-truth registries the passes check against.
+
+Everything here is extracted from the repo's own source of truth at
+check time — ``mxnet_tpu/env.py`` for the knob registry, ``mxnet_tpu/
+fault.py`` for the seam list, ``README.md`` for the documented knob
+tables — so the checker can never drift from the code it polices.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+_MXNET_NAME = re.compile(r"^MXNET_[A-Z0-9_]+$")
+
+
+class RepoModel:
+    """Lazily-extracted registries for the repo rooted at ``root``."""
+
+    def __init__(self, root):
+        self.root = root
+        self._env = None
+        self._seams = None
+        self._readme = None
+
+    # -- env knob registry (mxnet_tpu/env.py) ------------------------------
+    def _load_env(self):
+        if self._env is not None:
+            return
+        wired, subsumed, declared, anchors = set(), set(), set(), {}
+        path = os.path.join(self.root, "mxnet_tpu", "env.py")
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+            body = tree.body
+            # skip the module docstring: a knob must be *registered*
+            # (describe()/_SUBSUMED/a read), not merely name-dropped
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant):
+                body = body[1:]
+            for node in body:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str) and \
+                            _MXNET_NAME.match(sub.value):
+                        declared.add(sub.value)
+                        anchors.setdefault(sub.value, sub.lineno)
+            # wired = names in describe()'s `wired` table; subsumed =
+            # _SUBSUMED keys:
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "_SUBSUMED"
+                        for t in node.targets):
+                    if isinstance(node.value, ast.Dict):
+                        for k in node.value.keys:
+                            if isinstance(k, ast.Constant) and \
+                                    isinstance(k.value, str):
+                                subsumed.add(k.value)
+                if isinstance(node, ast.FunctionDef) and \
+                        node.name == "describe":
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Tuple) and sub.elts and \
+                                isinstance(sub.elts[0], ast.Constant) and \
+                                isinstance(sub.elts[0].value, str) and \
+                                _MXNET_NAME.match(str(sub.elts[0].value)):
+                            wired.add(sub.elts[0].value)
+                            anchors[sub.elts[0].value] = sub.lineno
+        self._env = {"wired": wired, "subsumed": subsumed,
+                     "declared": declared | wired | subsumed,
+                     "anchors": anchors,
+                     "path": os.path.relpath(path, self.root).replace(
+                         os.sep, "/")}
+
+    @property
+    def env_registry(self):
+        """``{"wired", "subsumed", "declared", "anchors", "path"}`` —
+        ``declared`` is every exact MXNET_* name registered in env.py."""
+        self._load_env()
+        return self._env
+
+    # -- fault seams (mxnet_tpu/fault.py) ----------------------------------
+    @property
+    def fault_seams(self):
+        if self._seams is None:
+            self._seams = set()
+            path = os.path.join(self.root, "mxnet_tpu", "fault.py")
+            if os.path.exists(path):
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id == "SEAMS"
+                            for t in node.targets):
+                        for elt in ast.walk(node.value):
+                            if isinstance(elt, ast.Constant) and \
+                                    isinstance(elt.value, str):
+                                self._seams.add(elt.value)
+        return self._seams
+
+    # -- README knob mentions ----------------------------------------------
+    @property
+    def readme_knobs(self):
+        if self._readme is None:
+            self._readme = set()
+            path = os.path.join(self.root, "README.md")
+            if os.path.exists(path):
+                with open(path, encoding="utf-8") as f:
+                    self._readme = set(
+                        re.findall(r"MXNET_[A-Z0-9_]+", f.read()))
+        return self._readme
