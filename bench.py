@@ -360,7 +360,13 @@ def bench_overlap():
       1 KiB..64 MiB message sizes.  On one device this measures the
       per-call dispatch+copy overhead fusion amortizes (the collective
       itself is the identity); on a pod the same code path adds the
-      per-collective latency win.
+      per-collective latency win.  Each size also gets a ZeRO-1 arm
+      (ISSUE 7): the fused flat buffer through reduce-scatter +
+      all-gather in one jitted shard_map — the exact collective pair
+      ``MXNET_ZERO=1`` issues per bucket (``rs_ag_ms``/``rs_ag_gb_s``).
+    - ``zero_optimizer``: per-rank optimizer-state bytes, ZeRO vs
+      replicated, from a real 2-step ``MXNET_ZERO=1`` Trainer loop —
+      the ~1/dp memory win, read from the telemetry gauge.
     """
     import mxnet_tpu as mx
     from mxnet_tpu.gluon import nn
@@ -428,8 +434,24 @@ def bench_overlap():
     # on a pod the same curve adds the network latency win.
     import jax
     import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
 
+    from mxnet_tpu.parallel import collectives as coll
     from mxnet_tpu.parallel.collectives import allreduce_hosts
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    dp = len(jax.devices())
+
+    # the MXNET_ZERO per-bucket pair: reduce-scatter to 1/dp shards,
+    # all-gather the (here: identity) updated shard back — one jit,
+    # same program shape as ZeroBucketEngine._make_step; jit
+    # re-specializes per padded flat size
+    def _rs_ag_body(x):
+        s = coll.reduce_scatter(x, axis_name="dp")
+        return coll.all_gather(s, axis_name="dp", axis=0, tiled=True)
+
+    rs_ag_pair = jax.jit(coll.shard_map(_rs_ag_body, mesh, in_specs=(P(),),
+                                        out_specs=P()))
 
     curve = {}
     for label, elems, k in (("1KiB", 256, 16), ("32KiB", 8192, 16),
@@ -455,8 +477,19 @@ def bench_overlap():
                     b, allreduce_hosts(flat, _testing_force=True)))
             jax.block_until_ready(outs)
 
+        def rs_ag():
+            outs = []
+            for b in plan.buckets:
+                flat = bucketing.pack([vals[i] for i in b.keys])
+                _, _, pad = bucketing.shard_layout(b.size, dp)
+                if pad:
+                    flat = jnp.pad(flat, (0, pad))
+                outs.extend(bucketing.unpack(b, rs_ag_pair(flat)))
+            jax.block_until_ready(outs)
+
         per_key()
-        fused()  # warm both jit paths
+        fused()  # warm every jit path
+        rs_ag()
         t0 = time.perf_counter()
         for _ in range(iters):
             per_key()
@@ -465,18 +498,70 @@ def bench_overlap():
         for _ in range(iters):
             fused()
         t_fused = (time.perf_counter() - t0) / iters
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            rs_ag()
+        t_zero = (time.perf_counter() - t0) / iters
         total_mb = k * elems * 4 / (1 << 20)
         curve[label] = {
             "tensors": k,
             "buckets": len(plan.buckets),
             "per_key_ms": round(t_key * 1e3, 3),
             "fused_ms": round(t_fused * 1e3, 3),
+            "rs_ag_ms": round(t_zero * 1e3, 3),
             "speedup": round(t_key / t_fused, 2) if t_fused else 0.0,
             "per_key_gb_s": round(total_mb / 1024 / t_key, 2),
             "fused_gb_s": round(total_mb / 1024 / t_fused, 2),
+            "rs_ag_gb_s": round(total_mb / 1024 / t_zero, 2),
         }
     out["allreduce_fused"] = curve
+    out["zero_optimizer"] = _bench_zero_optimizer_bytes(dp)
     return out
+
+
+def _bench_zero_optimizer_bytes(dp):
+    """Per-rank optimizer-state bytes, sharded vs replicated (the
+    MXNET_ZERO ~1/dp HBM win), measured from a real 2-step Trainer loop
+    through the telemetry gauge."""
+    import os
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, telemetry
+
+    prev = os.environ.get("MXNET_ZERO")
+    os.environ["MXNET_ZERO"] = "1"
+    try:
+        np.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(256, activation="relu"), gluon.nn.Dense(64))
+        net.initialize()
+        net(nd.zeros((2, 128)))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9},
+                           kvstore="device")
+        x = np.random.randn(8, 128).astype("f")
+        y = np.random.randn(8, 64).astype("f")
+        for _ in range(2):
+            with autograd.record():
+                loss = ((net(nd.array(x)) - nd.array(y)) ** 2).mean()
+            loss.backward()
+            tr.step(8)
+        sharded = telemetry.gauge("mxnet_zero_optimizer_bytes_per_rank").value
+        # replicated momentum = one fp32 buffer per parameter element
+        replicated = sum(
+            int(np.prod(p.shape)) * 4
+            for p in net.collect_params().values())
+        return {
+            "dp": dp,
+            "bytes_per_rank": int(sharded),
+            "replicated_bytes": int(replicated),
+            "ratio": round(sharded / replicated, 4) if replicated else 0.0,
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_ZERO", None)
+        else:
+            os.environ["MXNET_ZERO"] = prev
 
 
 def _probe_backend(timeout=90, retries=2):

@@ -17,8 +17,11 @@
 #               + the telemetry unit suite
 #   overlap     step-overlap smoke (prefetch + bucketed allreduce +
 #               async checkpoint on CPU; exact fused-collective count,
-#               data-phase shrink, SIGKILL fail-fast) + the overlap
-#               unit suite
+#               data-phase shrink, SIGKILL fail-fast) + the `zero`
+#               scenario (MXNET_ZERO=1: exactly 2 collectives per
+#               bucket per step, byte accounting vs the non-ZeRO path,
+#               1/dp optimizer memory, collectives.allreduce fault ->
+#               one supervised restart) + the overlap/zero unit suites
 #   lint        repo-specific static analysis (python -m tools.check:
 #               SPMD collective safety, hot-path host syncs, lock/thread
 #               hygiene, env-knob registry, fault-seam integrity — see
@@ -99,11 +102,19 @@ case "$LANE" in
     #    a shrinking data phase, and worker-SIGKILL fail-fast through
     #    the prefetch thread (PR 2 liveness deadline)
     JAX_PLATFORMS=cpu python ci/overlap_smoke.py
-    # 2) the unit suite (bucket determinism, bit-exact trajectories,
-    #    byte accounting, async-checkpoint failure domains).  The unit
-    #    lane also runs this file; the repeat is deliberate — the
-    #    overlap stage must stay green/triagable on its own (~10s)
-    JAX_PLATFORMS=cpu python -m pytest -q tests/test_overlap.py
+    # 2) the `zero` scenario (ISSUE 7): ZeRO-1 sharded weight update —
+    #    exactly 2 collectives per bucket per step, rs/ag byte parity
+    #    with the fused-allreduce path, 1/dp optimizer HBM, and a
+    #    collectives.allreduce-seam fault costing one supervised
+    #    restart, never the job
+    JAX_PLATFORMS=cpu python ci/zero_smoke.py
+    # 3) the unit suites (bucket determinism, bit-exact trajectories,
+    #    byte accounting, async-checkpoint failure domains; ZeRO
+    #    trajectories/checkpoints/replan).  The unit lane also runs
+    #    these files; the repeat is deliberate — the overlap stage must
+    #    stay green/triagable on its own (~20s)
+    JAX_PLATFORMS=cpu python -m pytest -q tests/test_overlap.py \
+      tests/test_zero.py
     ;;
   nightly)
     # large-tensor + model backwards-compatibility tier (reference:

@@ -1,0 +1,170 @@
+"""ZeRO-lane smoke (ISSUE 7): the `zero` scenario of the overlap lane.
+
+Run by ci/runtest.sh overlap as:
+
+    JAX_PLATFORMS=cpu python ci/zero_smoke.py
+
+Asserts, on an 8-virtual-device CPU mesh through the PUBLIC surface
+(gluon.Trainer with MXNET_ZERO=1, CheckpointManager, telemetry,
+fault.inject):
+
+1. a 5-step ZeRO loop issues EXACTLY 2 collectives per bucket per step
+   (one reduce-scatter + one all-gather), with reduce-scatter bytes ==
+   all-gather bytes and each equal to the replicated path's fused
+   bucket bytes modulo dp-padding (< dp elements per bucket);
+2. per-rank optimizer-state bytes are <= replicated/dp + padding (the
+   1/dp memory win), and the SGD trajectory is bit-identical to the
+   replicated path;
+3. a transient fault on the ``collectives.allreduce`` seam costs one
+   supervised restart, never the job: run_with_recovery resumes from
+   the published checkpoint and finishes the run.
+"""
+import os
+import sys
+import tempfile
+
+# the script lives in ci/; the repo root is the import root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# a dp>=2 mesh with no TPU pod: the same virtual-device trick the test
+# suite's conftest uses (must run before jax initializes)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, fault, gluon, nd, telemetry  # noqa: E402
+from mxnet_tpu.checkpoint import CheckpointManager, run_with_recovery  # noqa: E402
+
+STEPS = 5
+BATCH = 8
+
+
+def make_net(seed=0):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    # reset the gluon auto-name counter so param names (and therefore
+    # bucket entry signatures) are identical across the A/B nets
+    from mxnet_tpu.gluon import block as _block
+
+    _block._NAME_SCOPE.counters.clear()
+    del _block._NAME_SCOPE.scope_stack[:]
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.zeros((2, 8)))
+    return net
+
+
+def one_step(net, tr, rng):
+    x = nd.array(rng.randn(BATCH, 8).astype("f"))
+    y = nd.array((rng.randn(BATCH, 4) > 0).astype("f"))
+    with autograd.record():
+        loss = ((net(x) - y) ** 2).mean()
+    loss.backward()
+    tr.step(BATCH)
+
+
+def train_epoch(zero):
+    os.environ["MXNET_ZERO"] = "1" if zero else "0"
+    net = make_net()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9},
+                       kvstore="device")
+    rng = np.random.RandomState(7)
+    for _ in range(STEPS):
+        one_step(net, tr, rng)
+    return {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+
+
+def counter(name):
+    return telemetry.counter(name).value
+
+
+def main():
+    dp = len(jax.devices())
+    assert dp >= 2, f"zero_smoke needs a dp>=2 mesh, got {dp}"
+
+    # -- replicated baseline (also records fused bucket bytes) -------------
+    fused_b0 = counter("mxnet_allreduce_bucket_bytes_total")
+    rep = train_epoch(zero=False)
+    fused_bytes = counter("mxnet_allreduce_bucket_bytes_total") - fused_b0
+
+    # -- 1+2. the ZeRO loop: collective count, bytes, memory, trajectory ---
+    c0 = counter("mxnet_zero_collectives_total")
+    rs0 = counter("mxnet_zero_reduce_scatter_bytes_total")
+    ag0 = counter("mxnet_zero_all_gather_bytes_total")
+    zr = train_epoch(zero=True)
+    collectives = counter("mxnet_zero_collectives_total") - c0
+    rs_bytes = counter("mxnet_zero_reduce_scatter_bytes_total") - rs0
+    ag_bytes = counter("mxnet_zero_all_gather_bytes_total") - ag0
+
+    # 4 small fp32 params coalesce into exactly ONE bucket -> exactly 2
+    # collectives (reduce-scatter + all-gather) per step, deterministically
+    assert collectives == 2 * STEPS, \
+        f"expected exactly {2 * STEPS} ZeRO collectives, saw {collectives}"
+    assert rs_bytes == ag_bytes, (rs_bytes, ag_bytes)
+    # byte accounting consistent with the non-ZeRO path: the pair moves
+    # the same flat-buffer bytes the fused allreduce did, plus only the
+    # dp-divisibility padding (< dp elements per bucket per step)
+    pad_bound = STEPS * dp * 4
+    assert fused_bytes <= rs_bytes < fused_bytes + pad_bound, \
+        (fused_bytes, rs_bytes, pad_bound)
+
+    # 1/dp optimizer memory: momentum is one fp32 per param element
+    n_elems = sum(int(np.prod(v.shape)) for v in rep.values())
+    replicated_bytes = 4 * n_elems
+    per_rank = telemetry.gauge("mxnet_zero_optimizer_bytes_per_rank").value
+    assert per_rank <= replicated_bytes / dp + dp * 4, \
+        (per_rank, replicated_bytes, dp)
+    print(f"zero_smoke: {collectives} collectives / {STEPS} steps, "
+          f"{int(per_rank)}B state per rank vs {replicated_bytes}B "
+          f"replicated (dp={dp}): OK")
+
+    for (kr, vr), (kz, vz) in zip(sorted(rep.items()), sorted(zr.items())):
+        assert np.array_equal(vr, vz), (kr, kz)
+    print("zero_smoke: 5-step SGD trajectory bit-identical to the "
+          "replicated path: OK")
+
+    # -- 3. collectives.allreduce seam fault costs one step, not the job --
+    os.environ["MXNET_ZERO"] = "1"
+    attempts = []
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d)
+
+        def train_fn(start, manager):
+            attempts.append(start)
+            net = make_net()
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9},
+                               kvstore="device")
+            resumed = manager.restore(net, tr) or 0
+            rng = np.random.RandomState(7)
+            for s in range(resumed):  # realign the data stream
+                rng.randn(BATCH, 8), rng.randn(BATCH, 4)
+            for s in range(resumed + 1, STEPS + 1):
+                one_step(net, tr, rng)
+                manager.save(s, net, tr)
+            return "ok"
+
+        with fault.inject("collectives.allreduce", error=OSError, times=1):
+            out = run_with_recovery(train_fn, mgr, max_restarts=2)
+        assert out == "ok"
+        assert len(attempts) == 2, attempts  # one restart, job completed
+        assert mgr.latest_valid_step() == STEPS
+    print("zero_smoke: collectives.allreduce fault cost one supervised "
+          "restart, job completed: OK")
+    print("zero_smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

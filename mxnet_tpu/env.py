@@ -63,6 +63,14 @@ Wired vars (read at ``import mxnet_tpu``):
 - ``MXNET_ALLREDUCE_BUCKET_MB``: gradient-bucket size cap in MiB for the
   fused allreduce path (default 32; 0 disables fusion and every key gets
   its own collective — see parallel/bucketing.py).
+- ``MXNET_ZERO``: ZeRO-1 optimizer-state sharding on the bucketed
+  dense-grad path (default 0 = replicated optimizer state).  Each flat
+  grad bucket becomes reduce-scatter → this-rank's-shard optimizer
+  update → all-gather, with momentum/Adam moments permanently sharded
+  1/dp per rank — see :mod:`mxnet_tpu.parallel.zero`.  Requires
+  bucketing on (``MXNET_ALLREDUCE_BUCKET_MB`` > 0) and an optimizer
+  with a flat sharded update (SGD/Adam); everything else falls back to
+  the replicated path per key.
 - ``MXNET_CHECKPOINT_ASYNC``: default for ``CheckpointManager.save``'s
   ``async_`` parameter (0/unset = synchronous saves; explicit
   ``async_=`` always wins).
@@ -176,6 +184,12 @@ def allreduce_bucket_mb():
     return max(0, get_int("MXNET_ALLREDUCE_BUCKET_MB", 32))
 
 
+def zero_enabled():
+    """ZeRO-1 optimizer-state sharding on the bucketed grad path
+    (MXNET_ZERO, default off; parallel/zero.py)."""
+    return get_bool("MXNET_ZERO", False)
+
+
 def checkpoint_async_default():
     """Default for CheckpointManager.save(async_=None)
     (MXNET_CHECKPOINT_ASYNC, default off)."""
@@ -263,6 +277,8 @@ def describe():
          "gluon/data/prefetcher.py)"),
         ("MXNET_ALLREDUCE_BUCKET_MB", "fused-allreduce bucket cap in MiB "
          "(default 32; 0 = per-key collectives; parallel/bucketing.py)"),
+        ("MXNET_ZERO", "ZeRO-1 optimizer-state sharding on the bucketed "
+         "grad path (default 0 = replicated; parallel/zero.py)"),
         ("MXNET_CHECKPOINT_ASYNC", "default for CheckpointManager.save "
          "async_ (unset/0 = synchronous saves)"),
         ("MXNET_WATCHDOG_TIMEOUT_S", "per-step stall deadline in seconds "

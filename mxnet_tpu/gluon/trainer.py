@@ -56,6 +56,10 @@ class Trainer:
         self._update_on_kvstore = None
         self._telemetry = bool(telemetry)
         self._bucketer = None   # fused-allreduce plan cache (lazy)
+        self._zero = None       # ZeRO-1 sharded-update engine (lazy)
+        self._zero_warned = False
+        self._zero_done = set()  # param indices updated by ZeRO this step
+        self._zero_pending = []  # (generation, bucket) awaiting _update
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -143,7 +147,38 @@ class Trainer:
             self._init_kvstore()
         self._allreduce_grads()
 
+    def _zero_engine(self):
+        """The ZeRO-1 engine when the mode is active for this Trainer
+        (``MXNET_ZERO=1``, bucketing on, local optimizer ownership, and
+        an optimizer with a flat sharded update), else None."""
+        from ..parallel import bucketing as _bucketing
+        from ..parallel import zero as _zero
+
+        if not _zero.zero_enabled() or self._update_on_kvstore or \
+                self._kvstore is None or \
+                _bucketing.bucket_cap_bytes() <= 0:
+            return None
+        if not _zero.supports(self._optimizer):
+            if not self._zero_warned:
+                import warnings
+
+                warnings.warn(
+                    f"MXNET_ZERO=1 but "
+                    f"{type(self._optimizer).__name__} has no flat "
+                    f"sharded update; optimizer state stays replicated",
+                    stacklevel=2)
+                self._zero_warned = True
+            return None
+        if self._zero is None or self._zero.optimizer is not self._optimizer:
+            self._zero = _zero.ZeroBucketEngine(self._optimizer)
+            # a replicated checkpoint restored into ZeRO mode keeps its
+            # momentum: bucket shards adopt the updater's per-key state
+            self._zero.adopt = _zero.updater_adopter(self._updaters[0])
+        return self._zero
+
     def _allreduce_grads(self):
+        self._zero_done = set()
+        self._zero_pending = []
         if self._kvstore is None:
             return
         from ..parallel import bucketing as _bucketing
@@ -199,10 +234,15 @@ class Trainer:
             self._bucketer = _bucketing.Bucketer()
         plan = self._bucketer.plan_for(entries)
         gen = self._bucketer.generation
-        if getattr(self, "_bucket_gen_seen", None) != gen:
+        zero = self._zero_engine()
+        prev_gen = getattr(self, "_bucket_gen_seen", None)
+        if prev_gen != gen:
             # a replan retired the previous generation's bucket keys for
             # good: drop their compression residuals (flat arrays up to a
-            # full bucket each) or an oscillating signature leaks them
+            # full bucket each) or an oscillating signature leaks them —
+            # and harvest the retired generation's ZeRO shards so
+            # momentum re-flattens into the new plan instead of aliasing
+            # a different bucket composition
             self._bucket_gen_seen = gen
             comp = getattr(self._kvstore, "_compression", None)
             if comp is not None and hasattr(comp, "drop_residuals"):
@@ -210,7 +250,22 @@ class Trainer:
                     lambda k: isinstance(k, str)
                     and k.startswith("__grad_bucket")
                     and not k.endswith(f"g{gen}"))
+            if zero is not None and prev_gen is not None:
+                zero.retire(("gen", prev_gen))
         for b in plan.buckets:
+            if zero is not None and _bucketing.float_kind(b.dtype):
+                # ZeRO-1: reduce-scatter the flat bucket, update only
+                # this rank's shard (state permanently sharded 1/dp),
+                # all-gather the updated params — replaces the fused
+                # allreduce + replicated per-param update below.  The
+                # step is DEFERRED to _update so the split public API
+                # (allreduce_grads → edit grads → update) keeps its
+                # semantics: rescale_grad is the one update(batch_size)
+                # sets, and in-place grad edits between the calls feed
+                # the reduce-scatter (per local contribution — the
+                # cross-contribution sum happens inside the collective)
+                self._zero_pending.append((gen, b))
+                continue
             if not b.fused:
                 # singleton (oversized or lone dtype): per-key round trip,
                 # no pack/unpack overhead
@@ -245,6 +300,29 @@ class Trainer:
             self._kvstore.push(i, grads_by_idx[i])
             self._kvstore.pull(i, grads_by_idx[i])
 
+    def _zero_step_bucket(self, engine, gen, b, grads_by_idx, ndev):
+        """One ZeRO bucket step: pack grads + params flat, hand them to
+        the engine (reduce-scatter → sharded update → all-gather inside
+        one jit), broadcast the updated flat weight back into every
+        device slot.  The optimizer phase for these params happened
+        inside the collective pair — ``_update`` skips them."""
+        from ..ndarray.ndarray import NDArray
+        from ..parallel import bucketing as _bucketing
+
+        flats = [_bucketing.pack([grads_by_idx[i][j]._get()
+                                  for i in b.keys])
+                 for j in range(ndev)]
+        w_flat = _bucketing.pack([self._params[i].list_data()[0]._get()
+                                  for i in b.keys])
+        new_flat = engine.step_bucket(("gen", gen), b, flats, w_flat)
+        for i, part in zip(b.keys, _bucketing.unpack(b, new_flat)):
+            param = self._params[i]
+            nd_part = NDArray._from_jax(part)
+            for d in param.list_data():
+                d._set(nd_part.as_in_context(d.context)._get().astype(
+                    d._get().dtype))
+            self._zero_done.add(i)
+
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
@@ -255,12 +333,34 @@ class Trainer:
         if self._update_on_kvstore and self._kvstore is not None:
             # weights were already updated server-side during _allreduce_grads
             return
+        if self._zero_pending:
+            pending, self._zero_pending = self._zero_pending, []
+            for gen, b in pending:
+                grads_by_idx = {i: self._params[i].list_grad()
+                                for i in b.keys}
+                ndev = len(grads_by_idx[b.keys[0]])
+                self._zero_step_bucket(self._zero, gen, b, grads_by_idx,
+                                       ndev)
         updater = self._updaters[0]
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue
+            if i in self._zero_done:
+                # ZeRO already applied this param's update inside the
+                # reduce-scatter/all-gather pair (sharded state)
+                continue
             for w, g in zip(param.list_data(), param.list_grad()):
                 updater(i, g, w)
+
+    # Trainer states-file variants, discriminated by an explicit header
+    # like the kvstore's MXKVOPT1 (never by speculative unpickling):
+    # plain updater blob, or this magic + pickled {"updater": <blob>,
+    # "zero": <per-parameter sharded-state pieces>} when ZeRO-1 holds
+    # bucketed params' optimizer state in sharded form.  The zero
+    # payload is dp- and plan-agnostic (per-member pieces re-flattened
+    # from the bucket shard metadata), so a restore works onto a
+    # different dp size, a different bucket cap, or with MXNET_ZERO off.
+    _ZERO_MAGIC = b"MXTRZRO1"
 
     def _states_blob(self):
         """The bytes ``save_states`` writes — exposed so async
@@ -268,7 +368,13 @@ class Trainer:
         thread and hand only the file I/O to a background writer."""
         if self._update_on_kvstore and self._kvstore is not None:
             return self._kvstore._optimizer_states_blob(dump_optimizer=True)
-        return self._updaters[0].get_states(dump_optimizer=True)
+        blob = self._updaters[0].get_states(dump_optimizer=True)
+        if self._zero is not None and self._zero.has_state:
+            import pickle
+
+            return self._ZERO_MAGIC + pickle.dumps(
+                {"updater": blob, "zero": self._zero.state_payload()})
+        return blob
 
     def save_states(self, fname):
         """Reference: Trainer.save_states (optimizer state round-trip)."""
@@ -283,6 +389,28 @@ class Trainer:
             self._optimizer = self._kvstore._updater.optimizer
         else:
             with open(fname, "rb") as f:
-                self._updaters[0].set_states(f.read())
+                data = f.read()
+            zero_payload = None
+            if data.startswith(self._ZERO_MAGIC):
+                import pickle
+
+                obj = pickle.loads(data[len(self._ZERO_MAGIC):])
+                data, zero_payload = obj["updater"], obj["zero"]
+            self._updaters[0].set_states(data)
             self._optimizer = self._updaters[0].optimizer
+            self._zero = None  # rebind to the freshly-loaded optimizer
+            if zero_payload is not None:
+                engine = self._zero_engine()
+                if engine is not None:
+                    # shards re-flatten lazily at the first step of each
+                    # bucket — valid for ANY dp size / bucket plan
+                    engine.load_state_payload(zero_payload)
+                else:
+                    # ZeRO off (or unsupported) at restore time: fold
+                    # the sharded pieces back into the replicated
+                    # updater so momentum survives the mode switch
+                    from ..parallel import zero as _zero
+
+                    _zero.fold_into_updater(self._updaters[0],
+                                            zero_payload)
         self._optimizer.param_dict = {i: p for i, p in enumerate(self._params)}

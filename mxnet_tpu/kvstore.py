@@ -463,7 +463,12 @@ class KVStore:
     # 2. bundled: the 8-byte magic ``MXKVOPT1`` followed by a pickled
     #    ``{"updater": <plain blob>, "host_states": {key: state}}`` dict —
     #    host-resident row-sparse keys keep their optimizer state
-    #    server-side and it must survive the round trip.
+    #    server-side and it must survive the round trip.  Since ZeRO-1
+    #    (parallel/zero.py) the dict may also carry ``"zero"``: the
+    #    engine's per-parameter sharded-state payload, dp- and
+    #    plan-agnostic so a restore works onto a different dp size,
+    #    bucket cap, or with MXNET_ZERO off (folded back into the
+    #    replicated updater).  Old readers ignore unknown dict keys.
     #
     # The magic cannot collide with variant 1: updater blobs are pickle
     # streams and no pickle protocol starts with b"MXKV".  Readers that
@@ -479,9 +484,14 @@ class KVStore:
         blob = self._updater.get_states(dump_optimizer)
         host = {k: v.state for k, v in self._store.items()
                 if isinstance(v, _HostRowSparseTable) and v.state is not None}
-        if host:
-            return self._STATE_MAGIC + pickle.dumps(
-                {"updater": blob, "host_states": host})
+        zero = getattr(self, "_zero", None)
+        zero_payload = zero.state_payload() \
+            if zero is not None and zero.has_state else None
+        if host or zero_payload is not None:
+            bundle = {"updater": blob, "host_states": host}
+            if zero_payload is not None:
+                bundle["zero"] = zero_payload
+            return self._STATE_MAGIC + pickle.dumps(bundle)
         return blob
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
@@ -496,7 +506,8 @@ class KVStore:
             data = f.read()
         if data.startswith(self._STATE_MAGIC):
             obj = pickle.loads(data[len(self._STATE_MAGIC):])
-            self._adopt_bundled_states(obj["updater"], obj["host_states"])
+            self._adopt_bundled_states(obj["updater"], obj["host_states"],
+                                       obj.get("zero"))
             return
         # pre-MXKVOPT1 files only: one generation of bundled state shipped
         # as a bare pickled wrapper dict.  This is the sole remaining
@@ -511,13 +522,53 @@ class KVStore:
         else:
             self._updater.set_states(data)
 
-    def _adopt_bundled_states(self, updater_blob, host_states):
+    def _adopt_bundled_states(self, updater_blob, host_states,
+                              zero_payload=None):
+        from .parallel.distributed import ShardedOptimizerUpdater
+
+        if zero_payload is not None and \
+                isinstance(self._updater, ShardedOptimizerUpdater):
+            # The bundle was written by a ZeRO-mode store: its updater
+            # blob is the base Updater layout (bypass keys only — the
+            # bucketed keys' state travels in zero_payload).  This store
+            # runs the per-key sharded updater instead (MXNET_ZERO off on
+            # a dist store), so fold BOTH parts into its flat sharded
+            # per-key layout — the momentum buffers carry the same
+            # lr-folded form on every path, so values transfer exactly.
+            obj = pickle.loads(updater_blob)
+            if isinstance(obj, tuple) and len(obj) == 2:
+                states, self._updater.optimizer = obj
+                self._optimizer = self._updater.optimizer
+            else:
+                states = obj
+            self._updater.adopt_dense_states(states)
+            self._updater.adopt_dense_states(zero_payload["members"])
+            self._pending_host_state.update(host_states)
+            for k in list(self._pending_host_state):
+                cur = self._store.get(k)
+                if isinstance(cur, _HostRowSparseTable):
+                    cur.state = self._pending_host_state.pop(k)
+            return
         self._updater.set_states(updater_blob)
         self._pending_host_state.update(host_states)
         for k in list(self._pending_host_state):
             cur = self._store.get(k)
             if isinstance(cur, _HostRowSparseTable):
                 cur.state = self._pending_host_state.pop(k)
+        if zero_payload is None:
+            return
+        zero = getattr(self, "_zero", None)
+        if zero is not None:
+            # shards re-flatten lazily at each bucket's next step —
+            # valid for ANY dp size or bucket plan
+            zero.load_state_payload(zero_payload)
+        else:
+            # ZeRO off (or unsupported) at restore time: fold the
+            # sharded pieces back into the replicated updater so
+            # momentum survives the mode switch
+            from .parallel import zero as _zero
+
+            _zero.fold_into_updater(self._updater, zero_payload)
 
     def barrier(self):
         _ndm.waitall()
@@ -590,6 +641,9 @@ class DistTPUSyncKVStore(KVStore):
         super().__init__(kind)
         self._mesh = None
         self._fuse_bucketer = None  # deterministic fusion plan cache
+        self._zero = None           # ZeRO-1 bucketed sharded update
+        self._zero_bucketer = None  # multi-key push plan cache
+        self._zero_key_plans = {}   # per-key push: stable one-key plans
 
     @property
     def rank(self):
@@ -613,17 +667,27 @@ class DistTPUSyncKVStore(KVStore):
     def set_optimizer(self, optimizer):
         """update_on_kvstore distributed semantics (SURVEY.md §6.8): the
         server-side optimizer becomes a reduce-scatter + sharded-state update
-        + all-gather over the device mesh.  Optimizers without a jax-pure
-        sharded implementation fall back to the replicated local updater
-        (numerically identical, state not sharded)."""
+        + all-gather over the device mesh.  ``MXNET_ZERO=1`` runs that
+        recipe BUCKETED (parallel/zero.py: 2 collectives per flat bucket,
+        optimizer state permanently sharded 1/dp per rank) instead of the
+        per-key ShardedOptimizerUpdater (2 collectives per KEY).
+        Optimizers without a jax-pure sharded implementation fall back to
+        the replicated local updater (numerically identical, state not
+        sharded)."""
         from .parallel import distributed as _dist
+        from .parallel import zero as _zero
 
         super().set_optimizer(optimizer)
-        if _dist.supports_sharded_update(self._optimizer):
+        self._zero = None
+        self._sharded_update = False
+        if _zero.zero_enabled() and _zero.supports(self._optimizer):
+            self._zero = _zero.ZeroBucketEngine(self._optimizer)
+            # a replicated checkpoint restored into ZeRO mode keeps its
+            # momentum: bucket shards adopt the updater's per-key state
+            self._zero.adopt = _zero.updater_adopter(self._updater)
+        elif _dist.supports_sharded_update(self._optimizer):
             self._updater = _dist.ShardedOptimizerUpdater(self._optimizer)
             self._sharded_update = True
-        else:
-            self._sharded_update = False
 
     def push(self, key, value, priority=0):
         from .ndarray.sparse import RowSparseNDArray
@@ -647,10 +711,33 @@ class DistTPUSyncKVStore(KVStore):
                 # a device array, handing any host optimizer state to the
                 # updater, before the dist update path runs
                 self._store[k] = self._demote(k)
+        # ZeRO-1 partition: dense float keys with a server-side optimizer
+        # ride the bucketed reduce-scatter → sharded update → all-gather
+        # (their cross-process sum happens INSIDE the reduce-scatter, so
+        # they must not also ride the fused allreduce below); row-sparse
+        # and host-promoted keys keep the per-key replicated bypass
+        zero_set = set()
+        if self._zero is not None and self._updater is not None:
+            from .parallel import bucketing as _bucketing
+
+            zero_set = {k for k, red in zip(keys, reduced_list)
+                        if not isinstance(red, RowSparseNDArray)
+                        and not isinstance(self._store.get(k),
+                                           _HostRowSparseTable)
+                        and _bucketing.float_kind(red.dtype)}
         if self.num_workers > 1 and not (
                 getattr(self, "_sharded_update", False)
                 and self._updater is not None):
-            reduced_list = self._allreduce_bucketed(reduced_list, keys)
+            # ZeRO keys are excluded: their cross-process sum happens
+            # inside the reduce-scatter.  The call stays unconditional
+            # (an empty subset is a no-op) so every peer issues the same
+            # collective sequence regardless of the partition.
+            idxs = [j for j, k in enumerate(keys) if k not in zero_set]
+            sub = self._allreduce_bucketed(
+                [reduced_list[j] for j in idxs],
+                [keys[j] for j in idxs])
+            for j, v in zip(idxs, sub):
+                reduced_list[j] = v
         # int8 compression happens INSIDE the bucketed collective; a host
         # round-trip afterwards would quantize the already-summed gradient
         # a second time
@@ -660,7 +747,16 @@ class DistTPUSyncKVStore(KVStore):
                               and not (getattr(self, "_sharded_update",
                                                False)
                                        and self._updater is not None))
+        zero_batch = []
         for k, reduced in zip(keys, reduced_list):
+            if k in zero_set:
+                # compression round-trips BEFORE the pack, like the
+                # per-key sharded path (quantizing inside the
+                # reduce-scatter itself is the EQuARX item's hook)
+                if self._compression is not None:
+                    reduced = self._compression.round_trip(reduced, key=k)
+                zero_batch.append((k, reduced))
+                continue
             if getattr(self, "_sharded_update", False) and \
                     self._updater is not None:
                 # the sharded updater consumes the process-local reduced
@@ -676,6 +772,118 @@ class DistTPUSyncKVStore(KVStore):
                 self._updater(_key_int(k), reduced, self._store[k])
             else:
                 self._store[k] = reduced
+        if zero_batch:
+            self._zero_push(zero_batch)
+
+    def _zero_push(self, batch):
+        """ZeRO-1 server-side update for a batch of ``(key, reduced)``
+        dense float pairs: assign them to a deterministic bucket plan,
+        then per bucket run reduce-scatter → this-rank's-shard optimizer
+        update → all-gather (parallel/zero.py) and write the updated
+        weights back into the store.
+
+        Plan keying mirrors the PR 4 fusion cache, split by push shape:
+        a multi-key push rides one shared :class:`bucketing.Bucketer`
+        (its generation tags the engine state; a replan retires the old
+        generation's shards so momentum re-flattens instead of aliasing
+        a different bucket composition), while the common per-key push
+        pattern (update_on_kvstore trainers pushing one key at a time)
+        gets a stable per-key one-bucket plan — a shared planner would
+        replan on every call and thrash shard state."""
+        from .parallel import bucketing as _bucketing
+
+        if len(batch) > 1:
+            # a key switching from the per-key pattern hands its momentum
+            # over through the harvest (one resident state per key, never
+            # two independent shards double-advancing the update count)
+            for k, _ in batch:
+                old = self._zero_key_plans.pop(k, None)
+                if old is not None:
+                    self._zero.retire(("key", k, old[2]))
+            entries = [(k, tuple(v.shape), str(v.dtype)) for k, v in batch]
+            if self._zero_bucketer is None:
+                self._zero_bucketer = _bucketing.Bucketer()
+            plan = self._zero_bucketer.plan_for(entries)
+            gen = self._zero_bucketer.generation
+            prev = getattr(self, "_zero_gen_seen", None)
+            if prev != gen:
+                self._zero_gen_seen = gen
+                if prev is not None:
+                    self._zero.retire(("gen", prev))
+            vals = dict(batch)
+            for b in plan.buckets:
+                self._zero_step_bucket(("gen", gen), b, vals)
+            return
+        k, reduced = batch[0]
+        gen_seen = getattr(self, "_zero_gen_seen", None)
+        if gen_seen is not None:
+            # the symmetric hand-over: a multi-key generation is resident
+            # and this key may be part of it — harvest it so the one-key
+            # plan re-adopts the carried momentum (the next multi-key
+            # push lazily re-assembles from the same carry)
+            self._zero.retire(("gen", gen_seen))
+            self._zero_gen_seen = None
+        sig = (tuple(reduced.shape), str(reduced.dtype))
+        entry = self._zero_key_plans.get(k)
+        if entry is None or entry[0] != sig:
+            version = 0
+            if entry is not None:
+                # shape/dtype change retires the old one-key plan like a
+                # generation bump (state must never alias across layouts)
+                version = entry[2] + 1
+                self._zero.retire(("key", k, entry[2]))
+            (bucket,) = _bucketing.assign_buckets(
+                [(k, sig[0], sig[1])],
+                cap_bytes=_bucketing.bucket_cap_bytes()).buckets
+            entry = (sig, bucket, version)
+            self._zero_key_plans[k] = entry
+        self._zero_step_bucket(("key", k, entry[2]), entry[1],
+                               {k: reduced})
+
+    def _zero_step_bucket(self, tag, bucket, vals):
+        from .parallel import bucketing as _bucketing
+
+        flat = _bucketing.pack([vals[k]._get() for k in bucket.keys])
+        w_flat = _bucketing.pack([self._store[k]._get()
+                                  for k in bucket.keys])
+        new_flat = self._zero.step_bucket(
+            tag, bucket, [flat], w_flat,
+            opt_keys=[_key_int(k) for k in bucket.keys])
+        for k, part in zip(bucket.keys,
+                           _bucketing.unpack(bucket, new_flat)):
+            old = self._store[k]
+            self._store[k] = NDArray._from_jax(
+                part.astype(old._get().dtype), old.context)
+
+    def load_optimizer_states(self, fname):
+        super().load_optimizer_states(fname)
+        if self._zero is not None:
+            # a dump_optimizer blob replaced the updater's optimizer
+            # object: the engine must advance THAT one (update counts /
+            # Adam bias correction resume where the save left off)
+            from .parallel import zero as _zero
+
+            new_opt = self._updater.optimizer
+            if _zero.kind_of(new_opt) != self._zero._kind:
+                # the blob swapped the optimizer CLASS: the engine's
+                # jitted bodies and state layout are kind-specific, so
+                # rebinding alone would silently run the wrong math —
+                # rebuild.  (A sharded payload of the old kind was
+                # already rejected by load_state_payload's kind check;
+                # the replicated per-key states the blob carries are
+                # adopted into the new engine's shards at each bucket's
+                # first step.)
+                engine = None
+                if _zero.supports(new_opt):
+                    engine = _zero.ZeroBucketEngine(new_opt)
+                    engine.adopt = _zero.updater_adopter(self._updater)
+                self._zero = engine
+                self._zero_bucketer = None
+                self._zero_key_plans = {}
+                self._zero_gen_seen = None
+            else:
+                self._zero.optimizer = new_opt
+            self._optimizer = new_opt
 
     def _allreduce_bucketed(self, nds, keys=None):
         """Cross-host allreduce: jax makes a global array over the dp mesh

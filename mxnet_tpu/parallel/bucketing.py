@@ -32,7 +32,8 @@ from .. import env as _env
 from .. import telemetry as _telemetry
 
 __all__ = ["bucket_cap_bytes", "Bucket", "BucketPlan", "assign_buckets",
-           "Bucketer", "pack", "unpack", "record_fused", "record_bypass"]
+           "Bucketer", "pack", "unpack", "record_fused", "record_bypass",
+           "shard_layout", "float_kind"]
 
 _BUCKETS_TOTAL = _telemetry.counter(
     "mxnet_allreduce_buckets_total",
@@ -83,6 +84,11 @@ class Bucket:
     def fused(self):
         """Whether packing actually coalesces anything (>1 member)."""
         return len(self.keys) > 1
+
+    @property
+    def size(self):
+        """Total flat elements across members."""
+        return sum(self.sizes)
 
     def __repr__(self):  # pragma: no cover - debugging aid
         return (f"Bucket(#{self.index} dtype={self.dtype} "
@@ -157,6 +163,26 @@ class Bucketer:
             self.generation += 1
             _BUCKET_COUNT.set(len(self._plan.buckets))
         return self._plan
+
+
+def float_kind(dtype):
+    """True for float-family dtypes — the buckets ZeRO can shard (an
+    integer bucket has no meaningful optimizer update)."""
+    return _np.dtype(dtype).kind == "f"
+
+
+def shard_layout(size, dp):
+    """ZeRO shard layout for a flat buffer of ``size`` elements over
+    ``dp`` ranks: ``(padded_size, shard_size, pad)`` with ``padded_size``
+    the smallest dp-divisible size ≥ ``size``.  Deterministic and pure —
+    the reduce-scatter/all-gather pair and the persistent sharded
+    optimizer state both key off this layout, so it must be identical on
+    every peer (and is recomputed, never stored, so a checkpoint can be
+    restored onto a different dp)."""
+    dp = max(1, int(dp))
+    pad = (-int(size)) % dp
+    padded = int(size) + pad
+    return padded, padded // dp, pad
 
 
 def pack(values):

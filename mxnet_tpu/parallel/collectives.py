@@ -40,7 +40,50 @@ from functools import partial
 __all__ = ["psum", "pmean", "all_gather", "reduce_scatter", "ppermute",
            "all_to_all", "allreduce_hosts", "allreduce_hosts_quantized",
            "allreduce_hosts_quantized_multi", "allreduce_any",
-           "barrier", "shard_map"]
+           "barrier", "shard_map", "place_global", "fetch_global"]
+
+
+def place_global(host, sharding):
+    """Place a host array as a global array with ``sharding`` without
+    cross-host transfers.
+
+    ``jax.device_put(x, sharding)`` raises in a multi-process job when
+    the sharding spans non-addressable devices; build the global array
+    from each process's addressable shards instead (every process holds
+    the full value, the callback slices out the local shards).  Shared
+    by every sharded-state owner (ShardedOptimizerUpdater,
+    ZeroBucketEngine) so the multi-process placement workaround lives in
+    exactly one place.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(host), sharding)
+    host = np.asarray(host)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
+
+
+def fetch_global(arr):
+    """Host copy of a global array — the inverse of :func:`place_global`.
+
+    ``np.asarray`` on an array whose sharding spans non-addressable
+    devices raises in a multi-process job; gather the full value to
+    every host first.  The gather is itself a collective, so callers
+    must reach this uniformly on every process (harvest/save points
+    already are: replans are deterministic plan functions and
+    checkpoint saves happen at the same step on every peer).
+    """
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
 def shard_map(fn, mesh, in_specs, out_specs):

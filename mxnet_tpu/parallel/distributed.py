@@ -139,20 +139,11 @@ class ShardedOptimizerUpdater:
         return pad
 
     def _put(self, host, sharding):
-        """Place a host array with `sharding` without cross-host transfers.
+        """Place a host array with `sharding` without cross-host
+        transfers (see collectives.place_global)."""
+        from .collectives import place_global
 
-        ``jax.device_put(x, sharding)`` raises in a multi-process job when
-        the sharding spans non-addressable devices; build the global array
-        from each process's addressable shards instead (every process holds
-        the full value, the callback slices out the local shards)."""
-        import jax
-        import jax.numpy as jnp
-
-        if jax.process_count() == 1:
-            return jax.device_put(jnp.asarray(host), sharding)
-        host = _np.asarray(host)
-        return jax.make_array_from_callback(
-            host.shape, sharding, lambda idx: host[idx])
+        return place_global(host, sharding)
 
     # -- jit step ----------------------------------------------------------
     def _make_step(self, shape, dtype, clip):
@@ -293,7 +284,11 @@ class ShardedOptimizerUpdater:
     def get_states(self, dump_optimizer=False):
         import pickle
 
-        host = {k: tuple(_np.asarray(s) for s in v)
+        from .collectives import fetch_global
+
+        # fetch_global, not np.asarray: the state leaves span the whole
+        # mesh and a multi-process save must gather them to every host
+        host = {k: tuple(fetch_global(s) for s in v)
                 for k, v in self._state.items()}
         # version 2: sgd momentum buffer carries the lr-folded form
         # (mom' = mu*mom - lr*g); adam state is (m, v) with t in the
@@ -333,3 +328,30 @@ class ShardedOptimizerUpdater:
         self._state = restored
         if "optimizer" in payload:
             self.optimizer = payload["optimizer"]
+
+    def adopt_dense_states(self, states):
+        """Fold replicated per-key optimizer state (base ``Updater.states``
+        layout, or ZeRO payload member pieces — numpy/NDArray leaves,
+        single or tuple) into this updater's flat padded sharded layout.
+
+        This is how a checkpoint written by a *different* updater shape —
+        the ZeRO bucket engine (``MXNET_ZERO=1`` at save time) or a
+        single-process replicated updater — restores onto the per-key
+        sharded path: the momentum buffers carry the same lr-folded form
+        on every path, so values transfer without migration."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shard = NamedSharding(self._get_mesh(), P("w"))
+        n_state = 1 if self._kind == "sgd" else 2
+        for k, st in states.items():
+            leaves = st if isinstance(st, (tuple, list)) else (st,)
+            rs = []
+            for s in leaves:
+                if s is None:
+                    continue
+                arr = _np.asarray(s._get() if hasattr(s, "_get")
+                                  else s).reshape(-1)
+                arr = _np.pad(arr, (0, self._flat_spec(arr.size)))
+                rs.append(self._put(arr, shard))
+            if len(rs) == n_state:
+                self._state[k] = tuple(rs)

@@ -1,0 +1,564 @@
+"""ZeRO-1 optimizer-state sharding on the bucketed dense-grad path.
+
+Reference: "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (PAPERS.md, arXiv:2004.13336) — the weight
+update of data-parallel training is itself data-parallel over the
+replicas: instead of every replica paying one full-size allreduce per
+grad bucket and then redundantly applying the identical optimizer
+update to a full replica of the optimizer state, the flat bucket is
+
+    reduce-scatter'd  →  each rank updates ONLY its 1/dp shard
+                         (momentum/Adam moments live permanently
+                         sharded)              →  the updated params
+                         are all-gather'd back to every replica.
+
+Net effect: optimizer HBM drops to ~1/dp per rank, and the one
+bucket-sized allreduce becomes two half-cost collectives (a
+reduce-scatter moves the same bytes an allreduce's reduce phase does;
+the all-gather moves parameter bytes, which equal gradient bytes) —
+plus the update math itself runs on 1/dp of the elements.
+
+Layering (mirrors PR 4's bucketed fused allreduce, which this replaces
+when ``MXNET_ZERO=1``):
+
+- the :class:`~mxnet_tpu.parallel.bucketing.Bucketer` plan still decides
+  the flat bucket composition deterministically on every SPMD peer; the
+  per-rank shard layout is :func:`bucketing.shard_layout` — flat size
+  padded to dp-divisible, contiguous rank shards — and is a pure
+  function of (bucket size, dp), so every peer computes the same shards.
+- optimizer state is keyed by **(plan generation, bucket index)** —
+  exactly like the 2-bit compression residual keys — so a replan can
+  never alias state across different bucket compositions.  On a
+  generation bump the old shards are harvested back to per-parameter
+  host pieces and re-flattened into the new plan (momentum survives a
+  replan, and the same machinery restores a checkpoint onto a
+  *different* dp size or bucket cap).
+- the collective pair is issued inside ONE jitted ``shard_map``:
+  :func:`collectives.reduce_scatter` and :func:`collectives.all_gather`
+  at the same uniformity level in the same function — the contract the
+  ``MXT005`` static-analysis pass enforces for every future call site.
+
+Gating: ``MXNET_ZERO`` (default off).  Row-sparse and host-promoted
+keys stay on the per-key bypass (their payload is touched rows, not a
+stable flat span); non-float buckets and optimizers without a flat
+sharded implementation (:func:`supports`) fall back to the replicated
+path.  Gradient compression currently applies only to bypass keys in
+ZeRO mode — quantizing *inside* the reduce-scatter is the EQuARX item's
+hook (ROADMAP).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import env as _env
+from .. import fault as _fault
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from . import bucketing as _bucketing
+
+__all__ = ["zero_enabled", "supports", "ZeroBucketEngine",
+           "payload_to_states", "fold_into_updater"]
+
+# one reduce-scatter + one all-gather per bucket per step, each counted
+# exactly once at the issue site (the PR 4 byte-accounting discipline:
+# flat-buffer bytes, never re-added per member)
+_RS_BYTES = _telemetry.counter(
+    "mxnet_zero_reduce_scatter_bytes_total",
+    "flat-bucket bytes through the ZeRO reduce-scatter (padded, counted "
+    "once per bucket)")
+_AG_BYTES = _telemetry.counter(
+    "mxnet_zero_all_gather_bytes_total",
+    "updated-param bytes through the ZeRO all-gather (padded, counted "
+    "once per bucket)")
+_COLLECTIVES = _telemetry.counter(
+    "mxnet_zero_collectives_total",
+    "ZeRO collectives issued (exactly 2 per bucket per step: one "
+    "reduce-scatter + one all-gather)")
+_STATE_BYTES = _telemetry.gauge(
+    "mxnet_zero_optimizer_bytes_per_rank",
+    "per-rank bytes of sharded optimizer state currently resident "
+    "(~1/dp of the replicated path's)")
+_SHARD_BYTES = _telemetry.gauge(
+    "mxnet_zero_shard_bytes", "per-rank shard bytes of one bucket",
+    labelnames=("bucket",))
+
+# optimizers with a flat sharded update implementation; the math mirrors
+# ops/optimizer_ops.py element for element so trajectories match the
+# replicated kernels
+_SUPPORTED = {"SGD": "sgd", "Adam": "adam"}
+
+
+def zero_enabled():
+    """Whether ZeRO-1 sharding is on (``MXNET_ZERO``, default off)."""
+    return _env.zero_enabled()
+
+
+def supports(optimizer):
+    """True when ``optimizer`` has a flat sharded update (SGD/Adam)."""
+    return type(optimizer).__name__ in _SUPPORTED
+
+
+def kind_of(optimizer):
+    """The engine kind string for ``optimizer`` (None if unsupported)."""
+    return _SUPPORTED.get(type(optimizer).__name__)
+
+
+class ZeroBucketEngine:
+    """Sharded weight update for flat grad buckets.
+
+    One engine instance owns the sharded optimizer state of one
+    optimizer (a Trainer's, or a kvstore's server-side one).  Per
+    bucket-step the caller hands the packed flat gradient contributions
+    and the packed flat weight; the engine returns the updated flat
+    weight (a single-device array — callers broadcast it back into the
+    params/store exactly like a pulled bucket).
+    """
+
+    def __init__(self, optimizer):
+        kind = kind_of(optimizer)
+        if kind is None:
+            raise MXNetError(
+                f"ZeRO sharded update unsupported for "
+                f"{type(optimizer).__name__} (supported: "
+                f"{sorted(_SUPPORTED)})")
+        self.optimizer = optimizer
+        self._kind = kind
+        # (generation tag, bucket index) -> {"leaves", "members", "size",
+        # "dtype"}; leaves are global arrays sharded P("dp").  The
+        # generation tag is any hashable the CALLER derives from its plan
+        # generation (trainer: ("gen", Bucketer.generation); kvstore
+        # per-key: ("key", k, version)) — state can never alias across
+        # plans with different bucket compositions, exactly like the
+        # 2-bit compression residual keys
+        self._state = {}
+        # per-parameter state pieces awaiting (re)assembly into bucket
+        # shards: filled by load_state_payload (checkpoint restore, any
+        # dp / plan) and by a generation bump (replan harvest)
+        self._carry = {}
+        # optional hook: called with an optimizer index when a bucket
+        # member has no carried state; may return per-param state leaves
+        # (numpy, param-shaped) adopted from a replicated updater — a
+        # replicated checkpoint restored into ZeRO mode keeps momentum
+        self.adopt = None
+        self._jits = {}
+        self._mesh = None
+
+    # -- mesh / placement ---------------------------------------------------
+    def _get_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        if self._mesh is None:
+            self._mesh = Mesh(_np.array(jax.devices()), ("dp",))
+        return self._mesh
+
+    @property
+    def dp(self):
+        """Shard count: the full device mesh (every device owns 1/dp of
+        every bucket's optimizer state)."""
+        import jax
+
+        return len(jax.devices())
+
+    def _place(self, host, spec):
+        """Place a host array as a global array with PartitionSpec
+        ``spec`` (multi-process safe: built from addressable shards)."""
+        from jax.sharding import NamedSharding
+
+        from . import collectives as coll
+
+        return coll.place_global(host, NamedSharding(self._get_mesh(),
+                                                     spec))
+
+    # -- the jitted reduce-scatter -> sharded update -> all-gather step ----
+    def _get_step(self, padded, dtype, clip, vec_lr, vec_wd):
+        key = (padded, str(dtype), clip, vec_lr, vec_wd, self._n_state())
+        if key not in self._jits:
+            self._jits[key] = self._make_step(padded, clip, vec_lr, vec_wd)
+        return self._jits[key]
+
+    def _make_step(self, padded, clip, vec_lr, vec_wd):
+        """Build the jitted shard_map step for one (padded size, hyper
+        shape) signature.  ``clip`` is static (it selects whether the
+        clamp exists in the program, mirroring ops/optimizer_ops.py);
+        lr/wd/momentum/rescale are traced so schedules never retrace."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from . import collectives as coll
+
+        mesh = self._get_mesh()
+        dp = self.dp
+        shard = padded // dp
+        kind = self._kind
+        # lr/wd ride as scalars (replicated) unless per-param multipliers
+        # differ, then as flat vectors sharded exactly like the state
+        lr_spec = P("dp") if vec_lr else P()
+        wd_spec = P("dp") if vec_wd else P()
+
+        def prep(gstack, wf, wd, rescale):
+            # gstack: (1, padded) — this rank's contribution row.  The
+            # reduce-scatter sums all ranks' contributions and hands each
+            # rank its contiguous 1/dp shard of the summed gradient;
+            # then the same rescale -> clip -> +wd*w order as
+            # ops/optimizer_ops.py _prep, on the shard only.
+            g = coll.reduce_scatter(gstack[0], axis_name="dp")
+            g = g * rescale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            return g + wd * wf
+
+        def own_shard(wfull):
+            idx = jax.lax.axis_index("dp")
+            return jax.lax.dynamic_slice(wfull, (idx * shard,), (shard,))
+
+        # kind/momentum are construction-time optimizer config, identical
+        # on every SPMD peer; each arm DEFINES one jitted body issuing
+        # exactly the rs+ag pair — mxtpu: noqa[MXT003]
+        if kind == "adam":
+            def body(gstack, wfull, m, v, lr_t, wd, b1, b2, eps, rescale):
+                wf = own_shard(wfull)
+                g = prep(gstack, wf, wd, rescale)
+                # lr_t carries the bias correction (folded by the
+                # frontend like optimizer.Adam.update); eps sits outside
+                # the raw sqrt(v), matching adam_update
+                m_new = b1 * m + (1 - b1) * g
+                v_new = b2 * v + (1 - b2) * jnp.square(g)
+                wf_new = wf - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+                w_new = coll.all_gather(wf_new, axis_name="dp", axis=0,
+                                        tiled=True)
+                return w_new, (m_new, v_new)
+
+            in_specs = (P("dp", None), P(), P("dp"), P("dp"), lr_spec,
+                        wd_spec, P(), P(), P(), P())
+            out_specs = (P(), (P("dp"), P("dp")))
+        elif self._n_state():  # sgd with momentum
+            def body(gstack, wfull, mom, lr, wd, mu, rescale):
+                wf = own_shard(wfull)
+                g = prep(gstack, wf, wd, rescale)
+                # identical math to the sgd_mom_update kernel, on 1/dp
+                # of the elements; lr folds into the momentum buffer so
+                # schedules keep trajectories bit-identical
+                mom_new = mu * mom - lr * g
+                wf_new = wf + mom_new
+                w_new = coll.all_gather(wf_new, axis_name="dp", axis=0,
+                                        tiled=True)
+                return w_new, (mom_new,)
+
+            in_specs = (P("dp", None), P(), P("dp"), lr_spec, wd_spec,
+                        P(), P())
+            out_specs = (P(), (P("dp"),))
+        else:  # stateless sgd (momentum == 0)
+            def body(gstack, wfull, lr, wd, rescale):
+                wf = own_shard(wfull)
+                g = prep(gstack, wf, wd, rescale)
+                w_new = coll.all_gather(wf - lr * g, axis_name="dp",
+                                        axis=0, tiled=True)
+                return w_new, ()
+
+            in_specs = (P("dp", None), P(), lr_spec, wd_spec, P())
+            out_specs = (P(), ())
+        return jax.jit(coll.shard_map(body, mesh, in_specs=in_specs,
+                                      out_specs=out_specs))
+
+    def _n_state(self):
+        if self._kind == "adam":
+            return 2
+        return 1 if getattr(self.optimizer, "momentum", 0.0) else 0
+
+    # -- contributions ------------------------------------------------------
+    def _contributions(self, grad_flats, padded, dtype):
+        """The (total_devices, padded) contribution stack: row j carries
+        the j-th local contribution (one per device slot), every other
+        row is zeros — the reduce-scatter's sum is then EXACTLY the sum
+        of contributions, in any reduction order (x + 0 is exact), which
+        is what keeps ZeRO trajectories bit-identical to the replicated
+        path when there is a single contribution."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._get_mesh()
+        sharding = NamedSharding(mesh, P("dp", None))
+        n_total = self.dp
+        n_local = jax.local_device_count()
+        if jax.process_count() == 1:
+            rows = [jnp.pad(jnp.asarray(f, dtype),
+                            (0, padded - f.size)).reshape(1, padded)
+                    for f in grad_flats[:n_total]]
+            if len(rows) < n_total:
+                rows.append(jnp.zeros((n_total - len(rows), padded),
+                                      dtype))
+            return jax.device_put(jnp.concatenate(rows), sharding)
+        # multi-process: each process contributes its local block; row 0
+        # of the block is this process's reduced gradient, the rest zeros
+        block = _np.zeros((n_local, padded), dtype)
+        for j, f in enumerate(grad_flats[:n_local]):
+            block[j, :f.size] = _np.asarray(f)
+        return jax.make_array_from_process_local_data(sharding, block)
+
+    # -- state assembly / harvest -------------------------------------------
+    def _assemble(self, state_key, bucket, opt_keys, padded, dtype):
+        """Build the sharded state leaves for one bucket, re-flattening
+        any carried per-parameter pieces (checkpoint restore at any dp,
+        replan harvest, replicated-updater adoption) and zero-filling
+        the rest."""
+        from jax.sharding import PartitionSpec as P
+
+        n_state = self._n_state()
+        flats = [_np.zeros(padded, dtype) for _ in range(n_state)]
+        for key, off, size, shape in zip(opt_keys, bucket.offsets,
+                                         bucket.sizes, bucket.shapes):
+            pieces = self._carry.pop(key, None)
+            if pieces is None and self.adopt is not None:
+                pieces = self.adopt(key)
+            if pieces is None:
+                continue
+            if any(p is not None and _np.asarray(p).size != size
+                   for p in pieces):
+                # the parameter changed shape since this state was
+                # harvested/saved (e.g. a checkpoint restored onto an
+                # edited model): its old momentum is meaningless — reset
+                # to zeros instead of crashing on the size mismatch
+                continue
+            for flat, piece in zip(flats, pieces):
+                if piece is not None:
+                    flat[off:off + size] = _np.asarray(
+                        piece, dtype).reshape(-1)
+        leaves = tuple(self._place(f, P("dp")) for f in flats)
+        self._state[state_key] = {
+            "leaves": leaves, "members": tuple(
+                (k, off, size, tuple(shape))
+                for k, off, size, shape in zip(
+                    opt_keys, bucket.offsets, bucket.sizes,
+                    bucket.shapes)),
+            "size": bucket.size, "dtype": str(dtype)}
+        self._record_hbm(state_key)
+        return self._state[state_key]
+
+    @staticmethod
+    def _shard_label(state_key):
+        tag = "-".join(str(p) for p in state_key[0]) if \
+            isinstance(state_key[0], tuple) else str(state_key[0])
+        return f"{tag}.b{state_key[1]}"
+
+    def _record_hbm(self, state_key=None):
+        total = 0
+        for sk, entry in self._state.items():
+            per_rank = sum(lv.nbytes for lv in entry["leaves"]) // self.dp
+            total += per_rank
+            if state_key is None or sk == state_key:
+                _SHARD_BYTES.labels(bucket=self._shard_label(sk)).set(
+                    per_rank // max(1, self._n_state() or 1))
+        _STATE_BYTES.set(total)
+
+    def _harvest_entry(self, entry):
+        """Dissolve one bucket's sharded state back into per-parameter
+        host pieces (``self._carry``): flat state is re-flattened member
+        by member via the shard metadata, never reinterpreted in place.
+        Reached uniformly on every process (replans are deterministic),
+        so the multi-process gather inside fetch_global is SPMD-safe."""
+        from .collectives import fetch_global
+
+        fulls = [fetch_global(lv)[:entry["size"]]
+                 for lv in entry["leaves"]]
+        for key, off, size, shape in entry["members"]:
+            self._carry[key] = tuple(
+                full[off:off + size].reshape(shape) for full in fulls)
+
+    def retire(self, generation):
+        """A replan retired ``generation``'s bucket compositions for
+        good: harvest its shards to per-parameter pieces so momentum
+        survives into the next plan's (different) shard layout.  Callers
+        MUST retire the old generation before stepping a new one —
+        state is generation-keyed and would otherwise leak."""
+        for sk in [sk for sk in self._state if sk[0] == generation]:
+            self._harvest_entry(self._state.pop(sk))
+            # a retired shard is no longer resident: its labeled series
+            # must read 0, not its last value forever
+            _SHARD_BYTES.labels(bucket=self._shard_label(sk)).set(0)
+        self._record_hbm()
+
+    # -- the per-bucket step -----------------------------------------------
+    def step_bucket(self, generation, bucket, grad_flats, weight_flat,
+                    opt_keys=None):
+        """Reduce-scatter ``grad_flats`` (one flat contribution per local
+        device slot), apply this rank's shard of the optimizer update,
+        and all-gather the updated flat weight.
+
+        Returns the updated flat weight as a single-device array (the
+        caller broadcasts it back into params/store like a pulled
+        bucket).  ``generation`` is the caller's plan-generation tag
+        (any hashable; see ``_state``) — sharded state is keyed on it,
+        and the caller retires a stale generation via :meth:`retire`
+        before stepping the replacing one.  ``opt_keys`` maps bucket
+        members to optimizer indices (defaults to ``bucket.keys``)."""
+        import math
+
+        # the chaos seam: an injected transient here raises BEFORE any
+        # optimizer/state mutation, so run_with_recovery's restart costs
+        # exactly one step.  Never retried locally in multi-process
+        # (PR 2: a unilateral re-issue desyncs SPMD collective counts).
+        _fault.check("collectives.allreduce")
+        opt = self.optimizer
+        keys = list(bucket.keys) if opt_keys is None else list(opt_keys)
+        dtype = _np.dtype(bucket.dtype)
+        padded, shard, _pad = _bucketing.shard_layout(bucket.size, self.dp)
+        state_key = (generation, bucket.index)
+        entry = self._state.get(state_key)
+        if entry is None:
+            entry = self._assemble(state_key, bucket, keys, padded, dtype)
+        # hyperparameters: per-member update counts first (matches the
+        # per-key updater's calling order), then lr/wd, vectorized only
+        # when per-param multipliers actually differ
+        for k in keys:
+            opt._update_count(k)
+        lrs = [opt._get_lr(k) for k in keys]
+        wds = [opt._get_wd(k) for k in keys]
+        if self._kind == "adam":
+            lrs = [lr * math.sqrt(1.0 - opt.beta2 ** opt._index_update_count[k])
+                   / (1.0 - opt.beta1 ** opt._index_update_count[k])
+                   for lr, k in zip(lrs, keys)]
+        lr_arg, vec_lr = self._hyper_arg(lrs, bucket, padded)
+        wd_arg, vec_wd = self._hyper_arg(wds, bucket, padded)
+        clip = opt.clip_gradient if (opt.clip_gradient or 0) > 0 else None
+        rescale = opt.rescale_grad
+        jitted = self._get_step(padded, dtype, clip, vec_lr, vec_wd)
+        gstack = self._contributions(grad_flats, padded, dtype)
+        wfull = self._pad_weight(weight_flat, padded, dtype)
+        if self._kind == "adam":
+            m, v = entry["leaves"]
+            w_new, (m2, v2) = jitted(gstack, wfull, m, v, lr_arg, wd_arg,
+                                     opt.beta1, opt.beta2, opt.epsilon,
+                                     rescale)
+            entry["leaves"] = (m2, v2)
+        elif self._n_state():
+            (mom,) = entry["leaves"]
+            w_new, (mom2,) = jitted(gstack, wfull, mom, lr_arg, wd_arg,
+                                    getattr(opt, "momentum", 0.0), rescale)
+            entry["leaves"] = (mom2,)
+        else:
+            w_new, _ = jitted(gstack, wfull, lr_arg, wd_arg, rescale)
+        nbytes = padded * dtype.itemsize
+        _RS_BYTES.inc(nbytes)
+        _AG_BYTES.inc(nbytes)
+        _COLLECTIVES.inc(2)
+        self._record_hbm(state_key)
+        # the all-gathered output is replicated on every device; hand the
+        # caller one addressable copy so params stay single-device values
+        return w_new.addressable_data(0)
+
+    def _hyper_arg(self, values, bucket, padded):
+        """A scalar when every member shares the value, else a flat
+        padded per-element vector sharded like the state."""
+        from jax.sharding import PartitionSpec as P
+
+        if len(set(values)) <= 1:
+            return (values[0] if values else 0.0), False
+        flat = _np.zeros(padded, _np.float32)
+        for val, off, size in zip(values, bucket.offsets, bucket.sizes):
+            flat[off:off + size] = val
+        return self._place(flat, P("dp")), True
+
+    def _pad_weight(self, weight_flat, padded, dtype):
+        """The replicated (P()) flat weight input: padded to the
+        dp-divisible size and placed over the WHOLE mesh — a
+        single-device array cannot feed a jit whose other operands span
+        all devices."""
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        w = jnp.asarray(weight_flat, dtype)
+        if w.size != padded:
+            w = jnp.pad(w, (0, padded - w.size))
+        return self._place(w, P())
+
+    # -- checkpoint payload -------------------------------------------------
+    @property
+    def has_state(self):
+        return bool(self._state) or bool(self._carry)
+
+    def state_payload(self):
+        """Per-parameter host pieces of every resident shard — the
+        checkpoint representation.  Re-flattened from the per-bucket
+        shard metadata (member offsets), so a restore works onto ANY dp
+        size or bucket plan: assembly happens lazily at the first
+        step_bucket of each bucket."""
+        from .collectives import fetch_global
+
+        members = {}
+        for key, pieces in self._carry.items():
+            members[key] = tuple(None if p is None else _np.asarray(p)
+                                 for p in pieces)
+        for entry in self._state.values():
+            fulls = [fetch_global(lv)[:entry["size"]]
+                     for lv in entry["leaves"]]
+            for key, off, size, shape in entry["members"]:
+                members[key] = tuple(
+                    full[off:off + size].reshape(shape).copy()
+                    for full in fulls)
+        return {"version": 1, "kind": self._kind, "members": members}
+
+    def load_state_payload(self, payload):
+        if payload.get("kind") != self._kind:
+            raise MXNetError(
+                f"ZeRO state payload is for a {payload.get('kind')!r} "
+                f"optimizer, engine runs {self._kind!r}")
+        for sk in self._state:
+            _SHARD_BYTES.labels(bucket=self._shard_label(sk)).set(0)
+        self._state.clear()
+        self._carry = {k: tuple(v) for k, v in payload["members"].items()}
+        self._record_hbm()
+
+
+def updater_adopter(updater):
+    """An ``ZeroBucketEngine.adopt`` hook pulling per-parameter state out
+    of a replicated :class:`~mxnet_tpu.optimizer.optimizer.Updater` — a
+    replicated checkpoint restored into ZeRO mode keeps its momentum
+    (the state moves into the bucket shards and out of the updater)."""
+    def _adopt(key):
+        from ..kvstore import _flatten_state
+
+        st = updater.states.pop(key, None)
+        if st is None:
+            return None
+        updater.states_synced.pop(key, None)
+        leaves, _ = _flatten_state(st)
+        return tuple(None if lv is None else _np.asarray(lv._get())
+                     for lv in leaves)
+    return _adopt
+
+
+def fold_into_updater(updater, payload):
+    """Fold an engine checkpoint payload into a replicated
+    :class:`~mxnet_tpu.optimizer.optimizer.Updater` — the one place that
+    pokes the updater's state bookkeeping when a ZeRO checkpoint is
+    restored with ``MXNET_ZERO`` off (Trainer and kvstore restore paths
+    both call this)."""
+    states = payload_to_states(payload)
+    updater.states.update(states)
+    for k in states:
+        updater.states_synced[k] = True
+
+
+def payload_to_states(payload):
+    """Convert an engine checkpoint payload to replicated per-key
+    optimizer state NDArrays (``Updater.states`` layout) — restoring a
+    ZeRO checkpoint with ``MXNET_ZERO`` off keeps the momentum."""
+    import jax.numpy as jnp
+
+    from ..ndarray.ndarray import NDArray
+
+    kind = payload.get("kind")
+    out = {}
+    for key, pieces in payload["members"].items():
+        nds = [None if p is None else NDArray._from_jax(jnp.asarray(p))
+               for p in pieces]
+        if kind == "adam":
+            out[key] = tuple(nds)
+        elif len(nds) == 1:
+            out[key] = nds[0]
+        else:
+            out[key] = None
+    return out

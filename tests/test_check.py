@@ -79,13 +79,14 @@ def codes_at(findings, code):
 # -- framework --------------------------------------------------------------
 def test_pass_catalog_complete():
     passes = all_passes()
-    assert set(passes) == {"collective-safety", "host-sync-hot-path",
-                           "lock-thread-hygiene", "env-knob-registry",
-                           "fault-seam-integrity"}
+    assert set(passes) == {"collective-safety", "collective-pairing",
+                           "host-sync-hot-path", "lock-thread-hygiene",
+                           "env-knob-registry", "fault-seam-integrity"}
     all_codes = {c for cls in passes.values() for c in cls.codes}
-    assert all_codes == {"MXT001", "MXT002", "MXT003", "MXT010",
-                         "MXT020", "MXT021", "MXT022", "MXT030",
-                         "MXT031", "MXT032", "MXT040"}
+    assert all_codes == {"MXT001", "MXT002", "MXT003", "MXT005",
+                         "MXT006", "MXT010", "MXT020", "MXT021",
+                         "MXT022", "MXT030", "MXT031", "MXT032",
+                         "MXT040"}
 
 
 def test_parse_error_reported_not_fatal(tmp_path):
@@ -180,6 +181,150 @@ def test_mxt003_branch_imbalance(tmp_path):
         """)
     hits = codes_at(check(tmp_path), "MXT003")
     assert hits == [("mxnet_tpu/c.py", 5)]
+
+
+# -- MXT005-006 reduce-scatter pairing / bucket keying -----------------------
+def test_mxt005_unpaired_reduce_scatter(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/z.py", """
+        import jax
+        from .parallel.collectives import all_gather, reduce_scatter
+
+        def bad_unpaired(x):
+            return reduce_scatter(x, axis_name="dp")   # line 5
+
+        def ok_paired(x):
+            s = reduce_scatter(x, axis_name="dp")
+            return all_gather(s, axis_name="dp")
+
+        def ok_paired_in_nested_helpers(x):
+            # the zero.py shape: rs and ag live in sibling closures of
+            # ONE jitted unit — analyzed together
+            def prep(v):
+                return reduce_scatter(v, axis_name="dp")
+
+            def body(v):
+                return all_gather(prep(v), axis_name="dp")
+            return body(x)
+
+        def ok_gather_alone(x):
+            return all_gather(x, axis_name="dp")
+        """)
+    hits = codes_at(check(tmp_path), "MXT005")
+    assert hits == [("mxnet_tpu/z.py", 5)]
+
+
+def test_mxt005_pair_at_different_uniformity_levels(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/z2.py", """
+        import jax
+        from .parallel.collectives import all_gather, reduce_scatter
+
+        def bad_gather_rank_conditional(x):
+            s = reduce_scatter(x, axis_name="dp")      # line 5
+            if jax.process_index() == 0:
+                return all_gather(s, axis_name="dp")
+            return s
+
+        def ok_both_uniform(x):
+            s = reduce_scatter(x, axis_name="dp")
+            return all_gather(s, axis_name="dp")
+        """)
+    hits = codes_at(check(tmp_path), "MXT005")
+    assert hits == [("mxnet_tpu/z2.py", 5)]
+
+
+def test_mxt005_if_test_calls_and_loop_nested_guards(tmp_path):
+    """Calls in an ``if`` TEST expression count at the current level,
+    and a rank-conditional branch nested inside a for/while/with still
+    flips the guard for its arms (the walker recurses statement-wise
+    through compound statements instead of flat-walking them)."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/z3.py", """
+        import jax
+        from .parallel.collectives import all_gather, reduce_scatter
+
+        def bad_rs_in_if_test(x):
+            if reduce_scatter(x, axis_name="dp") is not None:  # line 5
+                return x
+            return x
+
+        def bad_gather_rank_guarded_inside_loop(x):
+            s = reduce_scatter(x, axis_name="dp")              # line 10
+            for _ in range(2):
+                if jax.process_index() == 0:
+                    s = all_gather(s, axis_name="dp")
+            return s
+
+        def ok_pair_inside_loop(x):
+            for _ in range(2):
+                s = reduce_scatter(x, axis_name="dp")
+                x = all_gather(s, axis_name="dp")
+            return x
+
+        def ok_pair_under_with(x, ctx):
+            with ctx:
+                s = reduce_scatter(x, axis_name="dp")
+                return all_gather(s, axis_name="dp")
+        """)
+    hits = codes_at(check(tmp_path), "MXT005")
+    assert hits == [("mxnet_tpu/z3.py", 5), ("mxnet_tpu/z3.py", 10)]
+
+
+def test_mxt005_functions_defined_in_module_level_blocks(tmp_path):
+    """Functions defined inside module-level for/while/try-except blocks
+    (conditional shims, version-gated fallbacks) are still analyzed —
+    the outermost-function scan recurses through every compound
+    statement, not just If/Try/With bodies."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/z4.py", """
+        import jax
+        from .parallel.collectives import all_gather, reduce_scatter
+
+        for _name in ("a",):
+            def bad_in_loop(x):
+                return reduce_scatter(x, axis_name="dp")   # line 6
+
+        try:
+            import nonexistent_mod
+        except ImportError:
+            def bad_in_handler(x):
+                return reduce_scatter(x, axis_name="dp")   # line 12
+
+        while False:
+            def ok_in_while(x):
+                s = reduce_scatter(x, axis_name="dp")
+                return all_gather(s, axis_name="dp")
+        """)
+    hits = codes_at(check(tmp_path), "MXT005")
+    assert hits == [("mxnet_tpu/z4.py", 6), ("mxnet_tpu/z4.py", 12)]
+
+
+def test_mxt005_skips_the_primitive_wrapper_definition(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/coll.py", """
+        import jax
+
+        def reduce_scatter(x, axis_name="dp"):
+            return jax.lax.psum_scatter(x, axis_name, tiled=True)
+        """)
+    assert not codes_at(check(tmp_path), "MXT005")
+
+
+def test_mxt006_bucket_key_generation(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/bk.py", """
+        def bad_key(b):
+            return f"__grad_bucket{b.index}"           # line 2
+
+        def ok_key(b, gen):
+            return f"__grad_bucket{b.index}g{gen}"
+
+        def ok_read_probe(k):
+            return k.startswith("__grad_bucket")
+        """)
+    hits = codes_at(check(tmp_path), "MXT006")
+    assert hits == [("mxnet_tpu/bk.py", 2)]
 
 
 # -- MXT010 host sync --------------------------------------------------------

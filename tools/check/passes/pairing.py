@@ -1,0 +1,228 @@
+"""MXT005-006: ZeRO collective pairing + bucket state keying.
+
+PR 7's ZeRO-1 sharded weight update (parallel/zero.py) added the
+reduce-scatter → sharded update → all-gather shape the ROADMAP called
+out as a new contract class.  Two invariants keep it SPMD-safe:
+
+- **MXT005** — every ``reduce_scatter`` call site must be paired with a
+  matching ``all_gather`` in the same (outermost) function, at the same
+  uniformity level: a reduce-scatter leaves each rank holding only its
+  shard, so a missing / rank-conditional / except-guarded all-gather
+  either strands the sharded value or desyncs the peers' collective
+  issue counts (the PR 2 equal-call-count contract, specialized to the
+  pair).  An ``all_gather`` on its own is fine — gathering is a
+  complete operation; scattering is not.  The analysis unit is the
+  outermost function *including its nested helpers* (the jitted
+  shard_map bodies in parallel/zero.py split prep/body into closures),
+  and the primitive wrapper definitions themselves
+  (``def reduce_scatter``/``def all_gather`` in parallel/collectives.py)
+  are exempt — the contract binds call sites, not the seam.
+- **MXT006** — transient per-bucket kvstore/state keys (the
+  ``__grad_bucket…`` family) must embed the plan generation.  Bucket
+  plans replan when the entry signature changes; state keyed per bucket
+  without the generation (compression error-feedback residuals, ZeRO
+  shard state) would silently alias across plans with different bucket
+  compositions — the exact leak PR 4 fixed by generation-keying residual
+  keys.  Flagged shapes: an f-string or string concatenation building a
+  key that starts with ``__grad_bucket`` whose dynamic parts never
+  mention a generation/version; reading such keys
+  (``k.startswith("__grad_bucket")``) is not a build and stays silent.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, names_in
+from ..core import Finding, Pass, register
+
+_RS_NAMES = {"reduce_scatter", "psum_scatter"}
+_AG_NAMES = {"all_gather"}
+# see passes/collectives.py: the shared condition vocabulary
+from .collectives import _classify, _rank_locals  # noqa: E402
+
+_GEN_MARKERS = {"gen", "generation", "version", "plan_generation"}
+_BUCKET_KEY_PREFIX = "__grad_bucket"
+
+
+def _tail(name):
+    return (name or "").rsplit(".", 1)[-1]
+
+
+def _calls_with_guard(fn, rank_locals):
+    """Yield ``(call, guarded)`` for every rs/ag call in ``fn``'s whole
+    subtree (nested defs included — closures run as part of the same
+    jitted unit here), where ``guarded`` is True when the call sits
+    under a rank-conditional branch or an except handler."""
+    out = []
+
+    def emit(node, guarded):
+        # expression position: every call in the subtree (lambda bodies
+        # included — ast.walk descends into them) at the current level
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                out.append((sub, guarded))
+
+    def walk(stmts, guarded):
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                # the test itself runs at the CURRENT level (a call
+                # inside `if reduce_scatter(...):` is unconditional)
+                emit(stmt.test, guarded)
+                arm = guarded or \
+                    _classify(stmt.test, rank_locals) == "rank"
+                walk(stmt.body, arm)
+                walk(stmt.orelse, arm)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body, guarded)
+                for h in stmt.handlers:
+                    walk(h.body, True)
+                walk(stmt.orelse, guarded)
+                walk(stmt.finalbody, guarded)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(stmt.body, guarded)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                # recurse statement-wise so a rank-conditional If NESTED
+                # in the loop still flips the guard for its arms
+                emit(stmt.iter, guarded)
+                walk(stmt.body, guarded)
+                walk(stmt.orelse, guarded)
+            elif isinstance(stmt, ast.While):
+                emit(stmt.test, guarded)
+                walk(stmt.body, guarded)
+                walk(stmt.orelse, guarded)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    emit(item.context_expr, guarded)
+                walk(stmt.body, guarded)
+            else:
+                emit(stmt, guarded)
+
+    walk(fn.body, False)
+    # ast.walk above revisits nested calls; dedupe by identity-ish key
+    seen, uniq = set(), []
+    for call, guarded in out:
+        key = (call.lineno, call.col_offset)
+        if key in seen:
+            continue
+        seen.add(key)
+        uniq.append((call, guarded))
+    return uniq
+
+
+def _outermost_functions(tree):
+    """Module- and class-level function defs (methods), NOT functions
+    nested inside other functions — those analyze with their parent."""
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child
+            elif isinstance(child, (ast.Module, ast.ClassDef)):
+                stack.append(child)
+            elif isinstance(child, (ast.If, ast.Try, ast.ExceptHandler,
+                                    ast.With, ast.AsyncWith, ast.For,
+                                    ast.AsyncFor, ast.While)):
+                stack.append(child)
+
+
+@register
+class CollectivePairing(Pass):
+    name = "collective-pairing"
+    codes = {
+        "MXT005": "reduce-scatter without a matching all-gather",
+        "MXT006": "bucket state key missing the plan generation",
+    }
+
+    def run(self, ctx, mod):
+        findings = []
+        for fn in _outermost_functions(mod.tree):
+            if fn.name in _RS_NAMES | _AG_NAMES:
+                continue  # primitive wrapper definition, not a call site
+            rank_locals = _rank_locals(fn)
+            calls = _calls_with_guard(fn, rank_locals)
+            rs = [(c, g) for c, g in calls
+                  if _tail(call_name(c)) in _RS_NAMES]
+            if not rs:
+                continue
+            ag_guards = {g for c, g in calls
+                         if _tail(call_name(c)) in _AG_NAMES}
+            for call, guarded in rs:
+                name = call_name(call) or "reduce_scatter"
+                if not ag_guards:
+                    findings.append(Finding(
+                        code="MXT005", path=mod.relpath, line=call.lineno,
+                        message=f"{name!r} has no matching all_gather in "
+                                f"{fn.name!r}",
+                        hint="a reduce-scatter leaves each rank holding "
+                             "only its shard; pair it with an all_gather "
+                             "in the same function (parallel/zero.py is "
+                             "the reference shape) or the sharded value "
+                             "escapes incomplete",
+                        scope=mod.qualname(call), key=f"unpaired:{name}",
+                        col=call.col_offset))
+                elif guarded not in ag_guards:
+                    findings.append(Finding(
+                        code="MXT005", path=mod.relpath, line=call.lineno,
+                        message=f"{name!r} and its all_gather sit at "
+                                f"different uniformity levels (one is "
+                                f"under a rank-conditional branch or "
+                                f"except handler)",
+                        hint="both halves of the pair must be reached by "
+                             "every rank the same number of times; hoist "
+                             "them to the same branch level (PR 2 "
+                             "equal-call-count contract)",
+                        scope=mod.qualname(call),
+                        key=f"level-mismatch:{name}",
+                        col=call.col_offset))
+        findings.extend(self._check_bucket_keys(mod))
+        return findings
+
+    # -- MXT006 -------------------------------------------------------------
+    def _check_bucket_keys(self, mod):
+        findings = []
+        for node in ast.walk(mod.tree):
+            built = self._built_key_parts(node)
+            if built is None:
+                continue
+            prefix, dynamic = built
+            if not prefix.startswith(_BUCKET_KEY_PREFIX):
+                continue
+            names = set()
+            for d in dynamic:
+                names |= names_in(d)
+            if not (names & _GEN_MARKERS):
+                findings.append(Finding(
+                    code="MXT006", path=mod.relpath, line=node.lineno,
+                    message=f"bucket key built from {prefix!r} without a "
+                            f"plan-generation component",
+                    hint="include the Bucketer generation in the key "
+                         "(f\"__grad_bucket{b.index}g{gen}\") so "
+                         "per-bucket state (compression residuals, ZeRO "
+                         "shards) never aliases across replans with "
+                         "different bucket compositions (PR 4 contract)",
+                    scope=mod.qualname(node),
+                    key=f"ungenerationed:{prefix}",
+                    col=node.col_offset))
+        return findings
+
+    @staticmethod
+    def _built_key_parts(node):
+        """``(literal_prefix, [dynamic subexpressions])`` when ``node``
+        BUILDS a key string (f-string or ``"..." + expr`` concat whose
+        literal head is a constant); None for anything else — plain
+        constants (``startswith`` probes) are reads, not builds."""
+        if isinstance(node, ast.JoinedStr):
+            if not node.values or not isinstance(node.values[0],
+                                                 ast.Constant):
+                return None
+            dynamic = [v for v in node.values
+                       if not isinstance(v, ast.Constant)]
+            if not dynamic:
+                return None
+            return str(node.values[0].value), dynamic
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add) \
+                and isinstance(node.left, ast.Constant) \
+                and isinstance(node.left.value, str):
+            return node.left.value, [node.right]
+        return None
