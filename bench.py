@@ -564,6 +564,141 @@ def _bench_zero_optimizer_bytes(dp):
             os.environ["MXNET_ZERO"] = prev
 
 
+def bench_serving():
+    """Serving-engine load generator (ISSUE 8).
+
+    Two arms against the AOT-compiled continuous-batching engine on the
+    tiny llama proxy:
+
+    - **closed loop**: N concurrent clients, each submitting its next
+      request the moment the previous completes — measures the
+      latency/throughput trade as the decode batch fills.
+    - **open loop**: requests arrive on a fixed schedule (at ~60% of the
+      closed-loop peak rate) regardless of completions — measures
+      latency under sustained arrival pressure, queueing included.
+
+    Reports p50/p99 latency and tokens/s(/chip) per concurrency level,
+    plus the engine diagnosis context: warmup cost, compiled-signature
+    count, batch occupancy, and the steady-state fresh-trace count
+    (which must be 0 — the ISSUE 8 contract)."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from mxnet_tpu import nd, serving, telemetry
+    from mxnet_tpu.gluon.model_zoo.language.llama import llama_tiny
+
+    net = llama_tiny()
+    net.initialize()
+    net(nd.zeros((1, 8), dtype="int32"))
+    eng = serving.ServingEngine(net, batch_buckets=[1, 2, 4],
+                                prefill_buckets=[8, 16], kv_pages=64,
+                                page_size=8, max_batch=4)
+    t0 = time.perf_counter()
+    eng.start()
+    warmup_s = time.perf_counter() - t0
+    # touch every bucket once so steady state is honestly steady
+    warm = [eng.submit(np.random.RandomState(k).randint(
+        1, 512, (n,)).astype("int32"), max_new_tokens=2)
+        for k, n in enumerate((3, 8, 11, 16))]
+    for q in warm:
+        q.result(timeout=300)
+    compile_before = telemetry.snapshot()["compile"]["count"]
+    n_chips = max(1, jax.local_device_count())
+    max_new = 8
+
+    def percentile(lat, p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+    def run_closed(conc, total=16):
+        lat, lock = [], threading.Lock()
+        per_client = total // conc
+
+        def client(k):
+            rr = np.random.RandomState(1000 + k)
+            for _ in range(per_client):
+                prompt = rr.randint(1, 512,
+                                    (int(rr.randint(1, 17)),)).astype("int32")
+                t1 = time.perf_counter()
+                eng.submit(prompt, max_new_tokens=max_new).result(
+                    timeout=600)
+                with lock:
+                    lat.append(time.perf_counter() - t1)
+
+        t1 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t1
+        lat.sort()
+        toks = len(lat) * max_new
+        return {
+            "requests": len(lat),
+            "p50_ms": round(percentile(lat, 0.50) * 1e3, 1),
+            "p99_ms": round(percentile(lat, 0.99) * 1e3, 1),
+            "requests_per_s": round(len(lat) / wall, 2),
+            "tokens_per_s": round(toks / wall, 1),
+            "tokens_per_s_chip": round(toks / wall / n_chips, 1),
+        }
+
+    closed = {str(c): run_closed(c) for c in (1, 2, 4)}
+
+    def run_open(rate_rps, total=24):
+        pending = []
+        start = time.perf_counter()
+        rr = np.random.RandomState(7)
+        for i in range(total):
+            target = start + i / rate_rps
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            prompt = rr.randint(1, 512,
+                                (int(rr.randint(1, 17)),)).astype("int32")
+            pending.append(eng.submit(prompt, max_new_tokens=max_new))
+        lat = []
+        for req in pending:
+            # the request records its own submit->done latency, so late
+            # collection here cannot inflate early completions
+            lat.append(req.result(timeout=600)["latency_s"])
+        wall = time.perf_counter() - start
+        lat.sort()
+        return {
+            "arrival_rps": round(rate_rps, 2),
+            "requests": total,
+            "p50_ms": round(percentile(lat, 0.50) * 1e3, 1),
+            "p99_ms": round(percentile(lat, 0.99) * 1e3, 1),
+            "tokens_per_s": round(total * max_new / wall, 1),
+            "tokens_per_s_chip": round(total * max_new / wall / n_chips,
+                                       1),
+        }
+
+    open_loop = run_open(max(0.5, 0.6 * closed["4"]["requests_per_s"]))
+    snap = telemetry.snapshot()
+    occ = snap["metrics"].get("mxnet_serving_batch_occupancy", {})
+    occ_samples = occ.get("samples", [])
+    occupancy = None
+    if occ_samples and occ_samples[0].get("count"):
+        occupancy = round(occ_samples[0]["sum"] / occ_samples[0]["count"],
+                          3)
+    fresh = snap["compile"]["count"] - compile_before
+    stats = eng.stats()
+    eng.close()
+    return {
+        "model": "llama_tiny",
+        "warmup_s": round(warmup_s, 2),
+        "compiled_signatures": stats["compiled_signatures"],
+        "fresh_traces_steady_state": int(fresh),
+        "batch_occupancy_mean": occupancy,
+        "kv_pool_bytes": stats["kv_pages"]["pool_bytes"],
+        "closed_loop": closed,
+        "open_loop": open_loop,
+    }
+
+
 def _probe_backend(timeout=90, retries=2):
     """Initialize the backend in a SUBPROCESS first, with a timeout.
 
@@ -654,6 +789,14 @@ def main():
         extra["overlap"] = bench_overlap()
     except Exception as e:
         extra["overlap"] = {"error": repr(e)[:200]}
+    try:
+        # serving engine (ISSUE 8): closed/open-loop load generation
+        # against the AOT-compiled continuous-batching server — p50/p99
+        # + tokens/s/chip per concurrency, with the zero-fresh-trace
+        # steady-state contract measured, not assumed
+        extra["serving"] = bench_serving()
+    except Exception as e:
+        extra["serving"] = {"error": repr(e)[:200]}
     try:
         # BASELINE binding metric: allreduce bandwidth (tools/bandwidth_
         # measure.py ≙ reference tools/bandwidth/measure.py).  The bus

@@ -22,6 +22,11 @@
 #               bucket per step, byte accounting vs the non-ZeRO path,
 #               1/dp optimizer memory, collectives.allreduce fault ->
 #               one supervised restart) + the overlap/zero unit suites
+#   serving     inference-engine smoke (AOT warmup, 100 concurrent
+#               mixed-length HTTP requests with ZERO fresh traces,
+#               completions bit-matching the full-context forward,
+#               queue-bound 429 rejection, real-child SIGTERM drain ->
+#               EXIT_PREEMPTED) + the serving unit suite
 #   lint        repo-specific static analysis (python -m tools.check:
 #               SPMD collective safety, hot-path host syncs, lock/thread
 #               hygiene, env-knob registry, fault-seam integrity — see
@@ -116,6 +121,18 @@ case "$LANE" in
     JAX_PLATFORMS=cpu python -m pytest -q tests/test_overlap.py \
       tests/test_zero.py
     ;;
+  serving)
+    # 1) end-to-end smoke through the PUBLIC surface: engine + HTTP on a
+    #    free port, 4 concurrent clients x 25 mixed-length requests with
+    #    the zero-fresh-trace assertion (ISSUE 8 acceptance), queue
+    #    backpressure, and a real child SIGTERMed mid-request (drain)
+    JAX_PLATFORMS=cpu python ci/serving_smoke.py
+    # 2) the unit suite (paged pool, scheduler, eviction parity,
+    #    artifact round trips).  The unit lane also runs this file; the
+    #    repeat is deliberate — the serving stage must stay
+    #    green/triagable on its own (~35s)
+    JAX_PLATFORMS=cpu python -m pytest -q tests/test_serving.py
+    ;;
   nightly)
     # large-tensor + model backwards-compatibility tier (reference:
     # tests/nightly/ + model_backwards_compatibility_check/); set
@@ -126,7 +143,7 @@ case "$LANE" in
     python bench.py | tee BENCH.json
     ;;
   *)
-    echo "unknown lane: $LANE (lint|unit|tpu|dist|chaos|telemetry|overlap|sanity|nightly|bench)" >&2
+    echo "unknown lane: $LANE (lint|unit|tpu|dist|chaos|telemetry|overlap|serving|sanity|nightly|bench)" >&2
     exit 2
     ;;
 esac

@@ -94,6 +94,25 @@ Wired vars (read at ``import mxnet_tpu``):
   collective every N-th ``lifecycle.check_stop()`` call (default 1;
   larger N amortizes the per-step scalar all-reduce, stop latency grows
   to at most N steps).
+- ``MXNET_SERVING_PORT``: default port for ``serving.serve``'s HTTP
+  endpoint (the inference routes mount beside the telemetry
+  ``/metrics`` on one 127.0.0.1 server; 0/unset = pick a free port).
+- ``MXNET_SERVING_MAX_BATCH``: decode-batch admission cap for the
+  serving engine (default 8; must fit the largest batch bucket).
+- ``MXNET_SERVING_BATCH_BUCKETS``: comma-separated decode batch-size
+  buckets the engine AOT-compiles (default ``1,2,4,8``; active rows pad
+  up to the nearest bucket so every step hits a compiled signature).
+- ``MXNET_SERVING_PREFILL_BUCKETS``: comma-separated prompt-length
+  buckets for the prefill executable (default ``32,64,128``; prompts
+  right-pad up — causal attention keeps real-position logits exact).
+- ``MXNET_SERVING_QUEUE``: admission-queue bound (default 64; a full
+  queue rejects with a clean backpressure error, HTTP 429).
+- ``MXNET_SERVING_KV_PAGES``: KV-cache pool size in pages (default 512;
+  page 0 is the reserved scratch page — see serving/kvcache.py).
+- ``MXNET_SERVING_PAGE_SIZE``: tokens per KV page (default 16).
+- ``MXNET_SERVING_DEADLINE_MS``: default per-request deadline in ms
+  covering queueing + generation (default 0 = none; per-request
+  ``deadline_ms`` overrides).
 - ``MXNET_SUBGRAPH_BACKEND``: subgraph backend applied automatically at
   Module bind time (see :mod:`mxnet_tpu.subgraph`; unset = none).
 - ``MXNET_NUM_WORKERS``: launcher-provided world size for
@@ -236,6 +255,52 @@ def stop_sync_every():
     return max(1, get_int("MXNET_STOP_SYNC_EVERY", 1))
 
 
+def serving_port():
+    """Default port for serving.serve's HTTP endpoint
+    (MXNET_SERVING_PORT, default 0 = pick a free port)."""
+    return max(0, get_int("MXNET_SERVING_PORT", 0))
+
+
+def serving_max_batch():
+    """Serving decode-batch admission cap (MXNET_SERVING_MAX_BATCH,
+    default 8)."""
+    return max(1, get_int("MXNET_SERVING_MAX_BATCH", 8))
+
+
+def serving_batch_buckets():
+    """Decode batch-size bucket spec (MXNET_SERVING_BATCH_BUCKETS,
+    default "1,2,4,8")."""
+    return get_str("MXNET_SERVING_BATCH_BUCKETS", "1,2,4,8")
+
+
+def serving_prefill_buckets():
+    """Prompt-length bucket spec (MXNET_SERVING_PREFILL_BUCKETS,
+    default "32,64,128")."""
+    return get_str("MXNET_SERVING_PREFILL_BUCKETS", "32,64,128")
+
+
+def serving_queue_bound():
+    """Serving admission-queue bound (MXNET_SERVING_QUEUE, default 64)."""
+    return max(1, get_int("MXNET_SERVING_QUEUE", 64))
+
+
+def serving_kv_pages():
+    """KV-cache pool pages (MXNET_SERVING_KV_PAGES, default 512; page 0
+    is the reserved scratch page)."""
+    return max(2, get_int("MXNET_SERVING_KV_PAGES", 512))
+
+
+def serving_page_size():
+    """Tokens per KV-cache page (MXNET_SERVING_PAGE_SIZE, default 16)."""
+    return max(1, get_int("MXNET_SERVING_PAGE_SIZE", 16))
+
+
+def serving_deadline_ms():
+    """Default per-request serving deadline in ms
+    (MXNET_SERVING_DEADLINE_MS, default 0 = none)."""
+    return max(0, get_int("MXNET_SERVING_DEADLINE_MS", 0))
+
+
 def describe():
     """One line per known var: current value and what it maps to."""
     lines = []
@@ -295,6 +360,22 @@ def describe():
          "SIGTERM/SIGINT handlers (default 1)"),
         ("MXNET_STOP_SYNC_EVERY", "stop-agreement collective every N-th "
          "check_stop (default 1; N steps max stop latency)"),
+        ("MXNET_SERVING_PORT", "serving.serve HTTP endpoint port "
+         "(default 0 = pick free; routes mount beside /metrics)"),
+        ("MXNET_SERVING_MAX_BATCH", "serving decode-batch admission cap "
+         "(default 8)"),
+        ("MXNET_SERVING_BATCH_BUCKETS", "decode batch-size buckets the "
+         "engine AOT-compiles (default 1,2,4,8)"),
+        ("MXNET_SERVING_PREFILL_BUCKETS", "prompt-length prefill buckets "
+         "(default 32,64,128)"),
+        ("MXNET_SERVING_QUEUE", "serving admission-queue bound "
+         "(default 64; full = clean 429 rejection)"),
+        ("MXNET_SERVING_KV_PAGES", "KV-cache pool pages (default 512; "
+         "page 0 reserved as scratch; serving/kvcache.py)"),
+        ("MXNET_SERVING_PAGE_SIZE", "tokens per KV-cache page "
+         "(default 16)"),
+        ("MXNET_SERVING_DEADLINE_MS", "default per-request serving "
+         "deadline in ms (default 0 = none)"),
         ("MXNET_SUBGRAPH_BACKEND", "subgraph backend applied at Module "
          "bind time (mxnet_tpu.subgraph; unset = none)"),
         ("MXNET_NUM_WORKERS", "launcher world size for distributed.init "

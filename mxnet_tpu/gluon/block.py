@@ -559,10 +559,19 @@ class HybridBlock(Block):
                 arg_params[name] = p.data()
         return sym, arg_params, aux_params
 
-    def export(self, path, epoch=0, *example_inputs):
+    def export(self, path, epoch=0, *example_inputs, manifest=True):
         """Reference: HybridBlock.export → ``path-symbol.json`` +
         ``path-{epoch:04d}.params`` (deploy format, loadable by
-        SymbolBlock.imports / Module.load_checkpoint)."""
+        SymbolBlock.imports / Module.load_checkpoint).
+
+        Also writes ``path-artifact.json`` — the serving manifest
+        (input avals, AMP epoch, StableHLO IR per signature) consumed by
+        ``mxnet_tpu.serving.load_artifact``, which reconstructs the
+        block and AOT-warms every manifest signature so a server pays
+        zero fresh traces in steady state (ISSUE 8; the Relay/TVM
+        deployment-IR boundary).  ``manifest=False`` skips it (callers
+        like ``serving.export_artifact`` that write a multi-signature
+        manifest themselves)."""
         example = example_inputs or getattr(self, "_last_input_shapes", None)
         if not example:
             raise MXNetError(
@@ -573,6 +582,10 @@ class HybridBlock(Block):
         from ..module.module import save_checkpoint as _save_ckpt
 
         _save_ckpt(path, epoch, sym, arg_params, aux_params)
+        if manifest:
+            from ..serving.artifact import write_manifest
+
+            write_manifest(self, path, epoch=epoch, signatures=[example])
 
 
 class SymbolBlock(HybridBlock):

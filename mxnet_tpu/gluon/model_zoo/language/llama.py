@@ -19,7 +19,8 @@ from ...parameter import Parameter
 from ... import nn
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama3_8b",
-           "llama_tiny", "RMSNorm"]
+           "llama_tiny", "RMSNorm", "serving_params", "prefill_apply",
+           "decode_apply"]
 
 
 class LlamaConfig:
@@ -251,6 +252,78 @@ class LlamaForCausalLM(HybridBlock):
     def config(self):
         return self._cfg
 
+    # -- incremental (KV-cached) decode -----------------------------------
+    def init_decode_cache(self, batch, max_len=None):
+        """Dense per-layer KV cache for :meth:`decode_step`.
+
+        Returns ``{"k", "v"}`` of shape (num_layers, batch, num_kv_heads,
+        max_len, head_dim) in the parameter dtype, plus ``"len"`` (tokens
+        cached so far; uniform across the batch for this dense API — the
+        serving engine's paged pool tracks per-row positions instead)."""
+        import jax.numpy as jnp
+
+        cfg = self._cfg
+        max_len = max_len or cfg.max_seq_len
+        dt = self.model.embed_tokens.weight.data().dtype
+        shape = (cfg.num_layers, batch, cfg.num_kv_heads, max_len,
+                 cfg.head_dim)
+        return {"k": jnp.zeros(shape, dtype=dt),
+                "v": jnp.zeros(shape, dtype=dt), "len": 0}
+
+    def prefill(self, ids, cache):
+        """Run the prompt through the full-context forward, seed ``cache``
+        with every layer's roped k/v, and return the logits (B, L, V) —
+        the same values ``self(ids)`` produces."""
+        from ....ndarray.ndarray import NDArray
+
+        ids_v = ids._get() if isinstance(ids, NDArray) else \
+            _np.asarray(ids)
+        logits, ks, vs = prefill_apply(serving_params(self), self._cfg,
+                                       ids_v)
+        L = ids_v.shape[1]
+        cache["k"] = cache["k"].at[:, :, :, :L, :].set(
+            ks.astype(cache["k"].dtype))
+        cache["v"] = cache["v"].at[:, :, :, :L, :].set(
+            vs.astype(cache["v"].dtype))
+        cache["len"] = L
+        from ....context import current_context
+
+        return NDArray._from_jax(logits, current_context())
+
+    def decode_step(self, ids, cache, positions=None):
+        """Single-token forward against the cache: feeds ``ids`` (B,) at
+        ``positions`` (default: ``cache["len"]`` for every row), writes
+        the new k/v in, advances ``cache["len"]``, and returns logits
+        (B, V) that bit-match ``self(full_ids)`` at the same position."""
+        import jax.numpy as jnp
+
+        from ....context import current_context
+        from ....ndarray.ndarray import NDArray
+
+        ids_v = ids._get() if isinstance(ids, NDArray) else \
+            jnp.asarray(_np.asarray(ids))
+        b = ids_v.shape[0]
+        if positions is None:
+            pos = jnp.full((b,), cache["len"], dtype=jnp.int32)
+            advance = True
+        else:
+            pos = jnp.asarray(positions).astype(jnp.int32)
+            advance = False
+
+        def join(i, k_new, v_new):
+            bi = jnp.arange(b)
+            cache["k"] = cache["k"].at[i, bi, :, pos, :].set(
+                k_new[:, :, 0, :].astype(cache["k"].dtype))
+            cache["v"] = cache["v"].at[i, bi, :, pos, :].set(
+                v_new[:, :, 0, :].astype(cache["v"].dtype))
+            return cache["k"][i], cache["v"][i], pos + 1
+
+        logits = decode_apply(serving_params(self), self._cfg, ids_v, pos,
+                              join)
+        if advance:
+            cache["len"] += 1
+        return NDArray._from_jax(logits, current_context())
+
     def pipeline_decompose(self, n_stages, train_mode=True):
         """Split the net for pipeline parallelism: embed (pre) ->
         ``n_stages`` homogeneous trunk stages of ``num_layers/n_stages``
@@ -313,6 +386,187 @@ class LlamaForCausalLM(HybridBlock):
             "layer_fn": layer_fn,
             "post_fn": post_fn,
         }
+
+
+# ==========================================================================
+# Incremental (KV-cached) decode — the serving-path forward (ISSUE 8).
+#
+# ``prefill_apply``/``decode_apply`` are *pure* functions over a
+# structural-name parameter tree, written to mirror ``hybrid_forward``
+# op-for-op (same registry-op bodies, same reshape/transpose order, same
+# fp32 softmax with the flash-attention NEG_INF mask convention) so the
+# single-token decode logits bit-match the full-context forward at every
+# position.  ``mxnet_tpu.serving`` jit-compiles them against bucketed
+# signatures (paged KV cache); the gluon-level ``LlamaForCausalLM.prefill``
+# / ``decode_step`` run them eagerly against a dense cache for tests and
+# small-scale use.
+# ==========================================================================
+def serving_params(net):
+    """Structural-name parameter tree for the pure serving forwards.
+
+    Keys are ``_collect_params_with_prefix`` block-path names
+    (``model.layers.0.self_attn.q_proj.weight``) — stable across global
+    auto-name prefixes, so an exported manifest binds to any instance of
+    the same architecture.  Values are the live jax arrays (no copy)."""
+    from collections import OrderedDict
+
+    return OrderedDict(
+        (name, p.data()._get())
+        for name, p in sorted(net._collect_params_with_prefix().items()))
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _dense_nb(x, weight):
+    """``F.FullyConnected(flatten=False, no_bias=True)`` body (ops/nn.py):
+    weight layout (units, in_units)."""
+    return _jnp().matmul(x, weight.T)
+
+
+def _decode_attention(q, k, v, n_valid, sm_scale):
+    """Single-query attention over a (padded) key context.
+
+    Mirrors ``ops.flash_attention._mha_with_lse`` bit-for-bit for one
+    query row: GQA repeat, fp32 scores, NEG_INF mask (``exp`` of it is
+    exactly 0.0, so padded keys add exact zeros to the same softmax sum
+    the full-context forward computes), max-shift softmax, value matmul
+    in the value dtype.  ``n_valid`` (B,) counts valid keys per row —
+    key j is visible iff ``j < n_valid`` ≡ the causal row of the
+    full-context mask at position ``n_valid - 1``."""
+    jnp = _jnp()
+    from ....ops.flash_attention import NEG_INF
+
+    hq, hkv = q.shape[1], k.shape[1]
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    # matmul, NOT einsum: at query length 1 XLA:CPU lowers the einsum
+    # contraction through a different kernel whose d-axis accumulation
+    # order diverges from the full-context einsum's rows (~1e-6); the
+    # batched matmul reproduces the full-context rows bit-for-bit
+    scores = jnp.matmul(q.astype(jnp.float32),
+                        jnp.swapaxes(k.astype(jnp.float32), -1, -2)) \
+        * sm_scale
+    mask = jnp.arange(k.shape[2])[None, :] < n_valid[:, None]      # (B, S)
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    m = scores.max(axis=-1, keepdims=True)
+    e = jnp.exp(scores - m)
+    denom = e.sum(axis=-1, keepdims=True)
+    p = e / denom
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _proj_qkv(params, cfg, pre, h, pos2):
+    """q/k/v projections + rope for one attention block (shared by the
+    prefill and decode paths so the cached k/v and the decode-step q are
+    computed by literally the same code)."""
+    from ....ops.attention_ops import rope as _rope
+
+    jnp = _jnp()
+    b, l = h.shape[0], h.shape[1]
+    hd = cfg.head_dim
+    q = _dense_nb(h, params[pre + "self_attn.q_proj.weight"]) \
+        .reshape(b, l, cfg.num_heads, hd).transpose(0, 2, 1, 3)
+    k = _dense_nb(h, params[pre + "self_attn.k_proj.weight"]) \
+        .reshape(b, l, cfg.num_kv_heads, hd).transpose(0, 2, 1, 3)
+    v = _dense_nb(h, params[pre + "self_attn.v_proj.weight"]) \
+        .reshape(b, l, cfg.num_kv_heads, hd).transpose(0, 2, 1, 3)
+    q = _rope(q, positions=pos2, base=cfg.rope_base)
+    k = _rope(k, positions=pos2, base=cfg.rope_base)
+    return q, k, v
+
+
+def _mlp_block(params, cfg, pre, h):
+    from jax import nn as _jnn
+
+    g = _dense_nb(h, params[pre + "mlp.gate_proj.weight"])
+    u = _dense_nb(h, params[pre + "mlp.up_proj.weight"])
+    return _dense_nb(_jnn.silu(g) * u, params[pre + "mlp.down_proj.weight"])
+
+
+def _embed(params, cfg, ids):
+    """``F.Embedding`` body (ops/tensor.py): clip + take."""
+    jnp = _jnp()
+    idx = jnp.clip(ids.astype(jnp.int32), 0, cfg.vocab_size - 1)
+    return jnp.take(params["model.embed_tokens.weight"], idx, axis=0)
+
+
+def prefill_apply(params, cfg, ids):
+    """Full-context forward that also returns every layer's roped k/v.
+
+    ``ids`` (B, L) int32.  Returns ``(logits (B, L, V), k (num_layers, B,
+    num_kv_heads, L, head_dim), v (same))`` — the logits are the same
+    computation as ``LlamaForCausalLM.__call__`` (so right-padding a
+    prompt never changes the logits at real positions: causal attention
+    means position i only sees j <= i), and the k/v stacks seed a decode
+    cache."""
+    if cfg.num_experts > 0:
+        raise MXNetError("incremental decode does not support MoE FFNs yet")
+    jnp = _jnp()
+    from ....ops.attention_ops import rms_norm as _rms
+    from ....ops.flash_attention import flash_attention as _fa
+
+    x = _embed(params, cfg, ids)
+    b, l = x.shape[0], x.shape[1]
+    hd = cfg.head_dim
+    ks, vs = [], []
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        h = _rms(x, params[pre + "input_layernorm.weight"], eps=cfg.rms_eps)
+        q, k, v = _proj_qkv(params, cfg, pre, h, None)
+        ks.append(k)
+        vs.append(v)
+        o = _fa(q, k, v, causal=True, sm_scale=1.0 / math.sqrt(hd))
+        o = o.transpose(0, 2, 1, 3).reshape(b, l, cfg.num_heads * hd)
+        x = x + _dense_nb(o, params[pre + "self_attn.o_proj.weight"])
+        h2 = _rms(x, params[pre + "post_attention_layernorm.weight"],
+                  eps=cfg.rms_eps)
+        x = x + _mlp_block(params, cfg, pre, h2)
+    x = _rms(x, params["model.norm.weight"], eps=cfg.rms_eps)
+    logits = _dense_nb(x, params["lm_head.weight"])
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def decode_apply(params, cfg, ids, positions, kv_join):
+    """One single-token decode step, pure.
+
+    ``ids`` (B,) int32 — the tokens to feed; ``positions`` (B,) int32 —
+    each row's sequence position.  ``kv_join(layer, k_new, v_new) ->
+    (K, V, n_valid)`` owns the cache: it must merge the new roped
+    k/v (B, num_kv_heads, 1, head_dim) into layer ``layer``'s context and
+    return the full (padded) key/value arrays plus the per-row valid-key
+    count (``positions + 1``).  Dense caches (``decode_step``) and the
+    serving paged pool both plug in here, so there is exactly one copy of
+    the decode math.  Returns logits (B, vocab)."""
+    if cfg.num_experts > 0:
+        raise MXNetError("incremental decode does not support MoE FFNs yet")
+    jnp = _jnp()
+    from ....ops.attention_ops import rms_norm as _rms
+
+    hd = cfg.head_dim
+    ids = jnp.asarray(ids)
+    x = _embed(params, cfg, ids)[:, None, :]                      # (B, 1, d)
+    b = x.shape[0]
+    pos = jnp.asarray(positions).astype(jnp.int32)                # (B,)
+    pos2 = pos[:, None]                                           # rope (B,1)
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        h = _rms(x, params[pre + "input_layernorm.weight"], eps=cfg.rms_eps)
+        q, k, v = _proj_qkv(params, cfg, pre, h, pos2)
+        K, V, n_valid = kv_join(i, k, v)
+        o = _decode_attention(q, K, V, n_valid, 1.0 / math.sqrt(hd))
+        o = o.transpose(0, 2, 1, 3).reshape(b, 1, cfg.num_heads * hd)
+        x = x + _dense_nb(o, params[pre + "self_attn.o_proj.weight"])
+        h2 = _rms(x, params[pre + "post_attention_layernorm.weight"],
+                  eps=cfg.rms_eps)
+        x = x + _mlp_block(params, cfg, pre, h2)
+    x = _rms(x, params["model.norm.weight"], eps=cfg.rms_eps)
+    return _dense_nb(x, params["lm_head.weight"])[:, 0, :]        # (B, V)
 
 
 def llama3_8b(**overrides):

@@ -36,8 +36,8 @@ from collections import OrderedDict
 from .. import env as _env
 
 __all__ = ["enabled", "set_enabled", "set_capacity", "capacity", "lookup",
-           "insert", "make_key", "mark_unsafe", "stats", "reset_stats",
-           "clear"]
+           "insert", "make_key", "signature_key", "mark_unsafe", "stats",
+           "reset_stats", "clear"]
 
 _LOCK = threading.Lock()
 _CACHE = OrderedDict()          # key -> jitted callable (LRU: last = newest)
@@ -174,6 +174,37 @@ def make_key(opname, attrs, in_vals, amp_token, ctx_kind, training,
             count_bypass(sn)
             return None
     return (opname, akey, tuple(avals), amp_token, ctx_kind, bool(training))
+
+
+def signature_key(name, in_vals, extra=()):
+    """AOT-executable key with the eager fast path's keying discipline.
+
+    The serving engine (:mod:`mxnet_tpu.serving`) pre-compiles its
+    prefill/decode/sample executables per bucketed signature and must
+    serve steady state with ZERO fresh traces — the same contract the
+    LRU above enforces per op.  Sharing the key construction (aval
+    components + frozen static extras + AMP epoch + ctx kind) means a
+    change that would retrace here (new shape/dtype, AMP epoch flip,
+    context move) is exactly one that misses there, so the PR 3 compile
+    tracer sees both worlds through one vocabulary.
+
+    ``in_vals`` may be arrays or ``jax.ShapeDtypeStruct``s; ``extra`` is
+    a tuple of simple static scalars (bucket ids, phase names).  Unlike
+    :func:`make_key` there is no bypass path: an unhashable component is
+    a caller bug and raises."""
+    items = tuple(_freeze(v) for v in extra)
+    if _UNHASHABLE in items:
+        raise ValueError(
+            f"signature_key({name!r}): unhashable static component in "
+            f"{extra!r}")
+    avals = tuple((tuple(v.shape), str(v.dtype)) for v in in_vals)
+    from .ndarray import _AMP
+    from ..context import current_context
+
+    amp_token = _AMP["epoch"] if _AMP["on"] else None
+    ctx = current_context()
+    return (name, items, avals, amp_token,
+            ctx.device_type if ctx is not None else None)
 
 
 def is_blocked(opname):

@@ -1,0 +1,47 @@
+"""mxnet_tpu.serving — AOT-lowered inference with continuous batching.
+
+The production serving plane the ROADMAP's north star calls for, built
+from four cooperating layers (ISSUE 8):
+
+- :mod:`.artifact` — the Relay/TVM-style deployment-IR boundary:
+  ``HybridBlock.export`` freezes symbol + params + a signature manifest
+  with StableHLO; :func:`load_artifact` reconstructs and AOT-warms it.
+- :mod:`.scheduler` — requests, the bounded admission queue with
+  deadlines, and bucket arithmetic for continuous batching.
+- :mod:`.kvcache` — the block-paged KV pool (page tables per sequence,
+  scratch page 0 for padded rows, eviction by returning pages).
+- :mod:`.engine` — :class:`ServingEngine`: AOT-compiled prefill /
+  paged-decode / sampling executables keyed with the PR 1 dispatch-cache
+  discipline, a zero-fresh-trace steady-state loop, telemetry metric
+  families, the HTTP inference routes mounted beside ``/metrics``, and
+  :func:`serve` honoring the PR 5 graceful-drain lifecycle.
+
+Quickstart::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.gluon.model_zoo.language.llama import llama_tiny
+
+    net = llama_tiny()
+    net.initialize()
+    net(mx.nd.zeros((1, 8), dtype="int32"))
+    eng = serving.ServingEngine(net).start()
+    req = eng.submit([1, 2, 3], max_new_tokens=8)
+    print(req.result(timeout=30)["token_ids"])
+    eng.close()
+"""
+from .artifact import (LoadedArtifact, export_artifact, load_artifact,
+                       manifest_path, write_manifest)
+from .engine import ServingEngine, serve
+from .kvcache import PagedKVCache, pages_for
+from .scheduler import (AdmissionQueue, DeadlineExceededError,
+                        QueueFullError, Request, bucket_for, parse_buckets)
+
+__all__ = [
+    "ServingEngine", "serve",
+    "export_artifact", "load_artifact", "write_manifest", "manifest_path",
+    "LoadedArtifact",
+    "PagedKVCache", "pages_for",
+    "Request", "AdmissionQueue", "QueueFullError", "DeadlineExceededError",
+    "bucket_for", "parse_buckets",
+]

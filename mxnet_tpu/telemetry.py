@@ -52,6 +52,7 @@ from . import env as _env
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "exponential_buckets", "register_collector", "snapshot",
            "render_prometheus", "start_http_server", "stop_http_server",
+           "register_http_route", "unregister_http_route",
            "step_begin", "step_end", "step_abort", "step_scope", "phase",
            "maybe_phase", "timeline", "compile_event", "compile_events",
            "heartbeat", "last_heartbeat", "reset"]
@@ -703,6 +704,40 @@ def reset():
 # --------------------------------------------------------------------------
 _HTTP_SERVER = None
 _HTTP_THREAD = None
+_HTTP_ROUTES: dict = {}   # path -> handler(method, path, query, body_bytes)
+
+
+def register_http_route(path, handler):
+    """Mount an application route on the telemetry endpoint.
+
+    ``handler(method, path, query, body_bytes) -> (status, content_type,
+    body_bytes)`` is called for GET and POST requests whose path matches
+    exactly.  This is how the serving plane (:mod:`mxnet_tpu.serving`)
+    exposes its inference API beside ``/metrics`` — one 127.0.0.1 server
+    per process, one port to scrape and to query.  Routes registered
+    after the server started are live immediately (the handler resolves
+    them per request).  Built-in paths (``/metrics``, ``/snapshot``,
+    ``/healthz``) cannot be shadowed."""
+    with _LOCK:
+        _HTTP_ROUTES[path] = handler
+
+
+def unregister_http_route(path):
+    """Remove a mounted route (idempotent)."""
+    with _LOCK:
+        _HTTP_ROUTES.pop(path, None)
+
+
+def _dispatch_route(method, path, query, body):
+    with _LOCK:
+        handler = _HTTP_ROUTES.get(path)
+    if handler is None:
+        return None
+    try:
+        return handler(method, path, query, body)
+    except Exception as e:   # a broken app route must not kill the server
+        return (500, "text/plain",
+                f"route {path} failed: {e!r}\n".encode())
 
 
 def start_http_server(port=None, addr="127.0.0.1"):
@@ -717,26 +752,41 @@ def start_http_server(port=None, addr="127.0.0.1"):
         port = _env.get_int("MXNET_TELEMETRY_PORT", 0)
 
     class _Handler(BaseHTTPRequestHandler):
-        def do_GET(self):
-            path = self.path.split("?", 1)[0]
-            if path in ("/metrics", "/"):
-                body = render_prometheus().encode()
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
-            elif path in ("/snapshot", "/json"):
-                body = json.dumps(snapshot()).encode()
-                ctype = "application/json"
-            elif path == "/healthz":
-                body = b"ok\n"
-                ctype = "text/plain"
-            else:
-                self.send_response(404)
-                self.end_headers()
-                return
-            self.send_response(200)
+        def _reply(self, status, ctype, body):
+            self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def do_GET(self):
+            path, _, query = self.path.partition("?")
+            if path in ("/metrics", "/"):
+                self._reply(200, "text/plain; version=0.0.4; charset=utf-8",
+                            render_prometheus().encode())
+            elif path in ("/snapshot", "/json"):
+                self._reply(200, "application/json",
+                            json.dumps(snapshot()).encode())
+            elif path == "/healthz":
+                self._reply(200, "text/plain", b"ok\n")
+            else:
+                out = _dispatch_route("GET", path, query, b"")
+                if out is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self._reply(*out)
+
+        def do_POST(self):
+            path, _, query = self.path.partition("?")
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            out = _dispatch_route("POST", path, query, body)
+            if out is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self._reply(*out)
 
         def log_message(self, *a):   # no per-scrape stderr spam
             pass

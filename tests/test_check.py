@@ -81,12 +81,13 @@ def test_pass_catalog_complete():
     passes = all_passes()
     assert set(passes) == {"collective-safety", "collective-pairing",
                            "host-sync-hot-path", "lock-thread-hygiene",
-                           "env-knob-registry", "fault-seam-integrity"}
+                           "env-knob-registry", "fault-seam-integrity",
+                           "serving-hot-path"}
     all_codes = {c for cls in passes.values() for c in cls.codes}
     assert all_codes == {"MXT001", "MXT002", "MXT003", "MXT005",
                          "MXT006", "MXT010", "MXT020", "MXT021",
                          "MXT022", "MXT030", "MXT031", "MXT032",
-                         "MXT040"}
+                         "MXT040", "MXT050"}
 
 
 def test_parse_error_reported_not_fatal(tmp_path):
@@ -347,6 +348,101 @@ def test_mxt010_hot_path_sync_flagged_cold_path_silent(tmp_path):
     assert hits == [("mxnet_tpu/gluon/trainer.py", 5),
                     ("mxnet_tpu/gluon/trainer.py", 6),
                     ("mxnet_tpu/gluon/trainer.py", 7)]
+
+
+def test_mxt010_serving_engine_is_a_hot_zone(tmp_path):
+    mini_repo(tmp_path)
+    code = """
+        import numpy as np
+
+        def _decode_step(toks):
+            host = np.asarray(toks)                # line 4
+            return host
+        """
+    put(tmp_path, "mxnet_tpu/serving/engine.py", code)
+    put(tmp_path, "mxnet_tpu/serving/scheduler.py", code)  # host-side: ok
+    hits = codes_at(check(tmp_path), "MXT010")
+    assert hits == [("mxnet_tpu/serving/engine.py", 4)]
+
+
+# -- MXT050 serving steady-state tracing ------------------------------------
+def test_mxt050_trace_in_steady_state_loop(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/serving/loop.py", """
+        import jax
+
+        def _decode_step(body, pool, ids):
+            fn = jax.jit(body)                        # line 4
+            out = jax.jit(body).lower(pool).compile() # line 5 (jit only)
+            shape = jax.eval_shape(body, ids)         # line 6
+            return fn, out, shape
+
+        def _normalize(text):
+            return text.lower()                       # str.lower: silent
+        """)
+    hits = codes_at(check(tmp_path), "MXT050")
+    assert ("mxnet_tpu/serving/loop.py", 4) in hits
+    assert ("mxnet_tpu/serving/loop.py", 6) in hits
+    assert all(p == "mxnet_tpu/serving/loop.py" and ln in (4, 5, 6)
+               for p, ln in hits)
+    assert not any(ln == 10 for _, ln in hits)
+
+
+def test_mxt050_compliant_twin_and_scope_allowlist(tmp_path):
+    mini_repo(tmp_path)
+    # compile-time-intent names: every trace call is allowed
+    put(tmp_path, "mxnet_tpu/serving/ok.py", """
+        import jax
+
+        def _aot_compile(body, avals):
+            return jax.jit(body).lower(*avals).compile()
+
+        def warmup(bodies, avals):
+            return [jax.eval_shape(b, *avals) for b in bodies]
+
+        class LoadedArtifact:
+            def _aot_compile_signature(self, avals):
+                return jax.jit(self._pure).lower(*avals).compile()
+        """)
+    # the same calls OUTSIDE serving/ are out of scope for this pass
+    put(tmp_path, "mxnet_tpu/elsewhere.py", """
+        import jax
+
+        def hotloop(body):
+            return jax.jit(body)
+        """)
+    assert codes_at(check(tmp_path), "MXT050") == []
+
+
+def test_mxt050_lower_flags_jit_receiver_not_strings(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/serving/mix.py", """
+        import jax
+
+        def route(req, jitted):
+            kind = req.kind.lower()                   # line 4: silent
+            return jitted.lower(req.aval)             # line 5: silent (no
+                                                      # jit/jax in receiver
+                                                      # names... flagged?)
+
+        def dispatch(body, aval):
+            return jax.jit(body).lower(aval)          # line 10: flagged
+        """)
+    hits = codes_at(check(tmp_path), "MXT050")
+    assert ("mxnet_tpu/serving/mix.py", 10) in hits
+    assert ("mxnet_tpu/serving/mix.py", 4) not in hits
+
+
+def test_mxt050_noqa_waiver(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/serving/waived.py", """
+        import jax
+
+        def _decode_step(body):
+            # mxtpu: noqa[MXT050] one-time fallback, measured off-path
+            return jax.jit(body)
+        """)
+    assert codes_at(check(tmp_path), "MXT050") == []
 
 
 # -- MXT020-022 lock/thread hygiene -----------------------------------------

@@ -1,0 +1,492 @@
+"""Serving engine: AOT-lowered inference with continuous batching and
+paged KV-cache decode (ISSUE 8).
+
+Coverage: the paged pool allocator, bucket/queue/deadline scheduling,
+the acceptance contracts (concurrent paged decode bit-matches the
+sequential full-context forward; zero fresh traces after warmup on a
+mixed-length run), keyed sampling reproducibility, eviction parity,
+the HTTP plane, artifact export/load round trips (both formats), and
+the graceful-drain lifecycle integration.
+"""
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, serving, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo.language.llama import llama_tiny
+from mxnet_tpu.serving.kvcache import PagedKVCache, pages_for
+from mxnet_tpu.serving.scheduler import (AdmissionQueue,
+                                         DeadlineExceededError,
+                                         QueueFullError, Request,
+                                         bucket_for, parse_buckets)
+
+
+# -- shared fixtures (AOT warmup is the expensive part: amortize) ----------
+@pytest.fixture(scope="module")
+def net():
+    net = llama_tiny()
+    net.initialize()
+    net(nd.zeros((1, 8), dtype="int32"))  # settle deferred shapes
+    return net
+
+
+@pytest.fixture(scope="module")
+def engine(net):
+    eng = serving.ServingEngine(net, batch_buckets=[1, 2],
+                                prefill_buckets=[8, 16], kv_pages=32,
+                                page_size=8, max_batch=2)
+    eng.start()
+    yield eng
+    eng.close()
+
+
+def ref_greedy(net, prompt, n):
+    """The acceptance reference: the same prompt run sequentially
+    through the full-context forward, greedy at each step."""
+    ids = list(np.asarray(prompt).ravel())
+    out = []
+    for _ in range(n):
+        arr = np.asarray(ids, dtype="int32")[None, :]
+        logits = net(nd.array(arr, dtype="int32")).asnumpy()
+        tok = int(logits[0, -1].argmax())
+        out.append(tok)
+        ids.append(tok)
+    return out
+
+
+# -- paged KV cache --------------------------------------------------------
+def test_pages_for():
+    assert pages_for(1, 8) == 1
+    assert pages_for(8, 8) == 1
+    assert pages_for(9, 8) == 2
+    assert pages_for(0, 8) == 1   # a sequence always owns a page
+
+
+def test_paged_kvcache_alloc_grow_free():
+    kv = PagedKVCache(2, 2, 4, pages=5, page_size=8)
+    assert kv.pages_free == 4            # page 0 is reserved scratch
+    assert kv.alloc("a", 7)              # 1 page
+    assert kv.alloc("b", 9)              # 2 pages
+    assert kv.pages_free == 1
+    assert 0 not in kv.table("a") + kv.table("b")
+    assert kv.ensure("a", 8)             # still 1 page
+    assert kv.ensure("a", 9)             # grows to 2
+    assert kv.pages_free == 0
+    assert not kv.ensure("b", 17)        # would need a 3rd page: refused
+    assert len(kv.table("b")) == 2       # untouched on refusal
+    assert kv.free("b") == 2
+    assert kv.pages_free == 2
+    assert kv.free("b") == 0             # idempotent
+    with pytest.raises(KeyError):
+        kv.table("b")
+
+
+def test_paged_kvcache_alloc_is_all_or_nothing():
+    kv = PagedKVCache(2, 2, 4, pages=4, page_size=8)
+    assert kv.alloc("a", 16)             # 2 of 3 pages
+    assert not kv.alloc("b", 17)         # needs 3: refused whole
+    assert kv.pages_free == 1
+    assert not kv.holds("b")
+
+
+def test_paged_kvcache_table_rows_pad_with_scratch():
+    kv = PagedKVCache(2, 2, 4, pages=6, page_size=8)
+    kv.alloc("a", 20)                    # 3 pages
+    kv.alloc("b", 3)                     # 1 page
+    rows = kv.table_rows(["a", "b", None], 4)
+    assert len(rows) == 3 and all(len(r) == 4 for r in rows)
+    assert rows[0][:3] == kv.table("a") and rows[0][3] == 0
+    assert rows[1][0] == kv.table("b")[0] and rows[1][1:] == [0, 0, 0]
+    assert rows[2] == [0, 0, 0, 0]       # padded batch row: all scratch
+    with pytest.raises(MXNetError):
+        kv.table_rows(["a"], 2)          # bucket smaller than the table
+
+
+# -- scheduler -------------------------------------------------------------
+def test_parse_buckets_and_bucket_for():
+    assert parse_buckets("8,4, 16") == [4, 8, 16]
+    assert bucket_for(5, [4, 8, 16]) == 8
+    assert bucket_for(16, [4, 8, 16]) == 16
+    assert bucket_for(17, [4, 8, 16]) is None
+    with pytest.raises(MXNetError):
+        parse_buckets("4,-2")
+    with pytest.raises(MXNetError):
+        parse_buckets("abc")
+
+
+def test_admission_queue_bound_and_requeue_exemption():
+    q = AdmissionQueue(2)
+    a, b, c = (Request([1]) for _ in range(3))
+    q.put(a)
+    q.put(b)
+    with pytest.raises(QueueFullError):
+        q.put(c)
+    q.requeue(c)                         # eviction re-admission is exempt
+    assert len(q) == 3
+    assert q.pop_ready() is c            # requeue goes to the FRONT
+
+
+def test_admission_queue_expires_deadlined_requests():
+    q = AdmissionQueue(4)
+    stale = Request([1], deadline_ms=1)
+    fresh = Request([2])
+    q.put(stale)
+    q.put(fresh)
+    time.sleep(0.01)
+    got = q.pop_ready()
+    assert got is fresh
+    with pytest.raises(DeadlineExceededError):
+        stale.result(timeout=1)
+
+
+def test_queue_drain_resolves_waiting_requests():
+    q = AdmissionQueue(4)
+    reqs = [Request([1]) for _ in range(3)]
+    for r in reqs:
+        q.put(r)
+    assert q.drain(lambda r: MXNetError("shutdown")) == 3
+    for r in reqs:
+        with pytest.raises(MXNetError):
+            r.result(timeout=1)
+
+
+# -- acceptance: paged concurrent decode == sequential full context --------
+def test_concurrent_streams_bit_match_sequential_full_context(net, engine):
+    """≥ 2 concurrent streams through the batched, paged server produce
+    the same greedy completions as the prompts run sequentially through
+    the full-context forward (the ISSUE 8 acceptance criterion)."""
+    r = np.random.RandomState(0)
+    prompts = [r.randint(1, 512, (n,)).astype("int32")
+               for n in (5, 9, 3, 12)]
+    reqs = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    results = [q.result(timeout=180) for q in reqs]
+    for prompt, res in zip(prompts, results):
+        assert res["token_ids"] == ref_greedy(net, prompt, 6)
+        assert res["prompt_len"] == prompt.size
+        assert res["finish_reason"] == "length"
+        assert res["ttft_s"] is not None and res["latency_s"] > 0
+    # an eos_id hit ends the stream early with finish_reason "stop"
+    eos = results[0]["token_ids"][0]
+    res = engine.submit(prompts[0], max_new_tokens=6,
+                        eos_id=eos).result(timeout=60)
+    assert res["token_ids"] == [eos] and res["finish_reason"] == "stop"
+
+
+def test_zero_fresh_traces_after_warmup_mixed_lengths(engine):
+    """Steady state performs ZERO fresh traces: a mixed-length run after
+    warmup leaves the PR 3 compile tracer untouched (op, block,
+    serving — every kind).  The 100-request version runs in the CI
+    serving lane; this is the tier-1-sized pin."""
+    r = np.random.RandomState(1)
+    # touch every bucket once so the engine is fully warm
+    warm = [engine.submit(r.randint(1, 512, (n,)).astype("int32"),
+                          max_new_tokens=2) for n in (3, 8, 11, 16)]
+    for q in warm:
+        q.result(timeout=180)
+    snap0 = telemetry.snapshot()["compile"]["count"]
+    reqs = [engine.submit(r.randint(1, 512,
+                                    (int(r.randint(1, 17)),)).astype("int32"),
+                          max_new_tokens=int(r.randint(1, 5)))
+            for _ in range(24)]
+    for q in reqs:
+        q.result(timeout=300)
+    assert telemetry.snapshot()["compile"]["count"] == snap0
+    assert engine.stats()["latency_s"]["count"] >= 28
+
+
+def test_temperature_sampling_reproducible_and_batch_independent(net,
+                                                                 engine):
+    """Draw i of a request is fold_in(submit-time key, i): reproducible
+    under mx.random.seed and unchanged by what else shares the batch."""
+    mx.random.seed(123)
+    alone = engine.submit([5, 6, 7], max_new_tokens=5,
+                          temperature=0.7).result(60)["token_ids"]
+    mx.random.seed(123)
+    # same request resubmitted with a concurrent greedy neighbor: the
+    # batch composition differs, the sampled sequence must not
+    paired = engine.submit([5, 6, 7], max_new_tokens=5, temperature=0.7)
+    other = engine.submit([9, 9], max_new_tokens=5)
+    assert paired.result(60)["token_ids"] == alone
+    other.result(60)
+    # and greedy (temperature 0) ignores the RNG entirely
+    g1 = engine.submit([5, 6, 7], max_new_tokens=4).result(60)["token_ids"]
+    g2 = engine.submit([5, 6, 7], max_new_tokens=4).result(60)["token_ids"]
+    assert g1 == g2
+
+
+def test_queue_full_is_a_clean_rejection(net):
+    eng = serving.ServingEngine(net, batch_buckets=[1],
+                                prefill_buckets=[8], kv_pages=8,
+                                page_size=8, max_batch=1, queue_bound=1)
+    # NOT started: nothing drains the queue, so the bound is hit
+    # deterministically
+    eng._warm = True
+    eng.submit([1, 2], max_new_tokens=2)
+    with pytest.raises(QueueFullError):
+        eng.submit([3, 4], max_new_tokens=2)
+
+
+def test_submit_validation(net, engine):
+    with pytest.raises(MXNetError):
+        engine.submit([], max_new_tokens=2)          # empty prompt
+    with pytest.raises(MXNetError):
+        engine.submit([1] * 99, max_new_tokens=2)    # no prefill bucket
+    with pytest.raises(MXNetError):
+        engine.submit([1, 2], max_new_tokens=0)
+
+
+def test_deadline_expires_queued_request(net, engine):
+    """A request whose deadline lapses before prefill resolves with the
+    deadline error, not a stale completion."""
+    req = serving.Request([1, 2, 3], max_new_tokens=2, deadline_ms=0.01)
+    time.sleep(0.01)
+    engine._queue.put(req)
+    with pytest.raises(DeadlineExceededError):
+        req.result(timeout=30)
+
+
+# -- eviction --------------------------------------------------------------
+def test_eviction_under_pool_pressure_preserves_greedy(net):
+    eng = serving.ServingEngine(net, batch_buckets=[1, 2],
+                                prefill_buckets=[8, 16], kv_pages=4,
+                                page_size=8, max_batch=2)
+    eng.start()
+    try:
+        p = np.random.RandomState(2).randint(1, 512, (7,)).astype("int32")
+        a = eng.submit(p, max_new_tokens=10)
+        b = eng.submit(p[:5], max_new_tokens=10)
+        ra, rb = a.result(300), b.result(300)
+        # the pool (3 allocatable pages) cannot hold both at full length:
+        # at least one sequence was evicted and re-prefilled...
+        assert ra["prefills"] + rb["prefills"] >= 3
+        # ...and the outputs are exactly what sequential full-context
+        # greedy produces — eviction is invisible in the result
+        assert ra["token_ids"] == ref_greedy(net, p, 10)
+        assert rb["token_ids"] == ref_greedy(net, p[:5], 10)
+    finally:
+        eng.close()
+
+
+def test_admission_never_evicts_no_ping_pong(net):
+    """Two sequences that cannot coexist in the pool must serialize,
+    not evict each other per admission (the one-token-per-prefill
+    thrash): admission waits for free pages, so neither is ever
+    evicted."""
+    eng = serving.ServingEngine(net, batch_buckets=[1, 2],
+                                prefill_buckets=[8, 16], kv_pages=4,
+                                page_size=8, max_batch=2)
+    eng.start()
+    try:
+        r = np.random.RandomState(3)
+        p1 = r.randint(1, 512, (15,)).astype("int32")
+        p2 = r.randint(1, 512, (15,)).astype("int32")
+        a = eng.submit(p1, max_new_tokens=9)     # grows to 3 pages
+        b = eng.submit(p2, max_new_tokens=9)     # cannot coexist with a
+        ra, rb = a.result(300), b.result(300)
+        assert ra["prefills"] == 1 and rb["prefills"] == 1
+        assert ra["token_ids"] == ref_greedy(net, p1, 9)
+        assert rb["token_ids"] == ref_greedy(net, p2, 9)
+    finally:
+        eng.close()
+
+
+# -- HTTP plane ------------------------------------------------------------
+def test_http_completions_and_stats_routes(net, engine):
+    engine.mount_http()
+    server = telemetry.start_http_server(0)
+    port = server.server_address[1]
+    try:
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_new_tokens": 3}).encode()
+        resp = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"}), timeout=120)
+        assert resp.status == 200
+        out = json.loads(resp.read())
+        assert out["token_ids"] == ref_greedy(net, [1, 2, 3], 3)
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/serving", timeout=30)
+        stats = json.loads(resp.read())
+        assert stats["warm"] and stats["compiled_signatures"] > 0
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+        assert "mxnet_serving_request_seconds" in metrics
+        assert "mxnet_serving_kv_pages" in metrics
+        # malformed body: clean 400, not a dead connection
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions", data=b"not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=30)
+        assert ei.value.code == 400
+    finally:
+        engine.unmount_http()
+        telemetry.stop_http_server()
+
+
+def test_http_route_registry_survives_unregister():
+    telemetry.register_http_route("/test/x", lambda *a: (200, "t", b"y"))
+    server = telemetry.start_http_server(0)
+    port = server.server_address[1]
+    try:
+        assert urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/test/x", timeout=10).read() == b"y"
+        telemetry.unregister_http_route("/test/x")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/test/x",
+                                   timeout=10)
+        assert ei.value.code == 404
+    finally:
+        telemetry.unregister_http_route("/test/x")
+        telemetry.stop_http_server()
+
+
+# -- graceful drain --------------------------------------------------------
+def test_close_drains_in_flight_and_rejects_queued(net):
+    eng = serving.ServingEngine(net, batch_buckets=[1],
+                                prefill_buckets=[8], kv_pages=8,
+                                page_size=8, max_batch=1)
+    eng.start()
+    inflight = eng.submit([1, 2, 3], max_new_tokens=40)
+    time.sleep(0.05)     # let it prefill into the active set
+    eng.close(drain=True)
+    res = inflight.result(timeout=10)    # finished, not aborted
+    assert len(res["token_ids"]) == 40
+    with pytest.raises(MXNetError):
+        eng.submit([4, 5], max_new_tokens=2)
+
+
+def test_lifecycle_stop_request_drains_the_loop(net):
+    from mxnet_tpu import lifecycle
+
+    eng = serving.ServingEngine(net, batch_buckets=[1],
+                                prefill_buckets=[8], kv_pages=8,
+                                page_size=8, max_batch=1)
+    eng.start()
+    try:
+        inflight = eng.submit([7, 8], max_new_tokens=30)
+        time.sleep(0.05)
+        lifecycle.request_stop("test preemption")
+        eng.join(timeout=60)
+        assert not eng.running()         # loop honored the stop
+        assert len(inflight.result(10)["token_ids"]) == 30
+        with pytest.raises(MXNetError):
+            # queued-after-stop work is rejected, not silently dropped
+            eng.submit([1], max_new_tokens=1)
+    finally:
+        lifecycle.reset()
+        eng.close()
+
+
+# -- artifact export / load round trip -------------------------------------
+def test_export_writes_manifest_and_llama_roundtrip(net, tmp_path):
+    net.hybridize()
+    x = nd.array(np.arange(8, dtype="int32")[None, :], dtype="int32")
+    y0 = net(x).asnumpy()
+    path = str(tmp_path / "m")
+    net.export(path)
+    # both formats on disk
+    assert os.path.exists(path + "-symbol.json")
+    assert os.path.exists(path + "-0000.params")
+    assert os.path.exists(path + "-artifact.json")
+    with open(path + "-artifact.json") as f:
+        manifest = json.load(f)
+    assert manifest["format"] == "mxtpu-serving-artifact"
+    assert manifest["signatures"][0]["inputs"] == [
+        {"shape": [1, 8], "dtype": "int32"}]
+    assert "stablehlo" in manifest["signatures"][0]
+    assert manifest["amp_epoch"] is None
+    art = serving.load_artifact(path)
+    assert art.warmed == 1               # one signature AOT-compiled
+    y1 = art(x).asnumpy()
+    assert np.array_equal(y1, y0)        # identical outputs
+    net.hybridize(False)
+
+
+def test_artifact_repeat_calls_pay_zero_traces(net, tmp_path):
+    net.hybridize()
+    x = nd.array(np.arange(8, dtype="int32")[None, :], dtype="int32")
+    net(x)
+    path = str(tmp_path / "m2")
+    net.export(path)
+    art = serving.load_artifact(path)
+    y1 = art(x).asnumpy()
+    before = telemetry.snapshot()["compile"]["count"]
+    y2 = art(x).asnumpy()
+    assert telemetry.snapshot()["compile"]["count"] == before
+    assert np.array_equal(y1, y2)
+    net.hybridize(False)
+
+
+def test_export_roundtrip_mlp_bit_exact(tmp_path):
+    """For plain Dense stacks the symbol round trip is bit-exact; the
+    llama case above pins exactness through the AOT artifact path."""
+    mlp = nn.HybridSequential()
+    with mlp.name_scope():
+        mlp.add(nn.Dense(32, activation="relu", in_units=16))
+        mlp.add(nn.Dense(8, in_units=32))
+    mlp.initialize()
+    mlp.hybridize()
+    x = nd.array(np.random.RandomState(0).randn(4, 16).astype("f"))
+    y0 = mlp(x).asnumpy()
+    path = str(tmp_path / "mlp")
+    mlp.export(path)
+    art = serving.load_artifact(path)
+    assert np.array_equal(art(x).asnumpy(), y0)
+    # a NON-manifest signature (new batch size) still serves — but as a
+    # visible steady_state_miss in the compile tracer, not silently
+    x2 = nd.array(np.random.RandomState(1).randn(2, 16).astype("f"))
+    assert np.array_equal(art(x2).asnumpy(), mlp(x2).asnumpy())
+    causes = {e["cause"] for e in telemetry.compile_events()
+              if e["kind"] == "serving"}
+    assert "steady_state_miss" in causes
+    # the legacy format alone still round-trips too (SymbolBlock path)
+    from mxnet_tpu.gluon.block import SymbolBlock
+
+    legacy = SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                 path + "-0000.params")
+    np.testing.assert_allclose(legacy(x).asnumpy(), y0, rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_load_artifact_missing_manifest_raises(tmp_path):
+    with pytest.raises(MXNetError):
+        serving.load_artifact(str(tmp_path / "nope"))
+
+
+# -- engine manifest + validation ------------------------------------------
+def test_engine_manifest_covers_every_bucket(net, engine):
+    man = engine.manifest()
+    phases = {(s["phase"], s.get("tokens"), s.get("batch"), s.get("pages"))
+              for s in man["signatures"]}
+    for L in (8, 16):
+        assert any(p == "prefill" and t == L for p, t, _, _ in phases)
+    for B in (1, 2):
+        for P in man["page_buckets"]:
+            assert ("decode", None, B, P) in phases
+        assert any(p == "sample" and b == B for p, _, b, _ in phases)
+    # every manifest signature is actually compiled after start()
+    assert engine.stats()["compiled_signatures"] >= len(
+        [s for s in man["signatures"]])
+
+
+def test_engine_rejects_wrong_model_and_oversized_config(net):
+    with pytest.raises(MXNetError):
+        serving.ServingEngine(nn.Dense(4, in_units=4))
+    with pytest.raises(MXNetError):
+        # max_batch beyond the largest compiled batch bucket
+        serving.ServingEngine(net, batch_buckets=[1, 2],
+                              prefill_buckets=[8], kv_pages=16,
+                              page_size=8, max_batch=4)
+    with pytest.raises(MXNetError):
+        # prefill bucket beyond what the pool can ever hold
+        serving.ServingEngine(net, batch_buckets=[1],
+                              prefill_buckets=[64], kv_pages=4,
+                              page_size=8)

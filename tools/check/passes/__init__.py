@@ -4,11 +4,13 @@ To add a pass: create a module here with a :class:`tools.check.core.Pass`
 subclass decorated with ``@register``, then import it below.  Codes are
 namespaced by decade: MXT00x collective-safety (001-003 general,
 005-006 reduce-scatter pairing / bucket keying), MXT01x hot-path,
-MXT02x lock/thread, MXT03x env knobs, MXT04x fault seams.
+MXT02x lock/thread, MXT03x env knobs, MXT04x fault seams, MXT05x
+serving steady-state (no traces outside AOT warmup).
 """
 from . import collectives  # noqa: F401
 from . import envknobs  # noqa: F401
 from . import faultseams  # noqa: F401
 from . import hotpath  # noqa: F401
 from . import pairing  # noqa: F401
+from . import serving  # noqa: F401
 from . import threads  # noqa: F401

@@ -31,6 +31,11 @@ HOT_ZONES = (
     "mxnet_tpu/gluon/trainer.py",
     "mxnet_tpu/contrib/amp/loss_scaler.py",
     "mxnet_tpu/module/bucketing_module.py",
+    # the serving engine's step loop + page pool (ISSUE 8): one waived
+    # token fetch per engine step is the design; everything else must
+    # stay lazily dispatched
+    "mxnet_tpu/serving/engine.py",
+    "mxnet_tpu/serving/kvcache.py",
 )
 
 _NP_ALIASES = {"np", "numpy", "_np", "onp"}

@@ -1,0 +1,84 @@
+"""MXT050: trace-triggering call in the serving steady-state path.
+
+The serving engine's whole contract (ISSUE 8) is that steady state pays
+**zero fresh traces**: every executable is AOT-compiled at startup from
+the signature manifest, and the per-step loop only *looks up*
+pre-compiled callables.  A ``jax.jit`` / ``.lower`` / ``eval_shape`` /
+``functionalize`` call that creeps into the loop re-introduces exactly
+the retrace storms the PR 3 compile tracer was built to diagnose — at
+request latency, where they hurt most.
+
+Rule: inside ``mxnet_tpu/serving/``, trace-triggering calls may appear
+only in functions whose (qualified) name declares compile-time intent —
+a name segment containing one of ``aot``, ``warmup``, ``compile``,
+``lower``, ``load``, ``export``, or ``manifest``.  Everything else in
+the package is presumed reachable from the steady-state loop and is
+flagged.  Flagged shapes:
+
+- ``jax.jit(...)`` / bare ``jit(...)`` / ``pjit(...)``
+- ``jax.eval_shape(...)`` / ``make_jaxpr(...)``
+- ``<jit-ish expr>.lower(...)`` (the receiver mentions ``jit``/``jax``;
+  plain ``str.lower()`` stays silent)
+- ``functionalize(...)`` (re-traces the whole block)
+
+Waive a deliberate exception inline with a reason:
+``# mxtpu: noqa[MXT050] <why this trace is not on the request path>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, names_in
+from ..core import Finding, Pass, register
+
+_SERVING_PREFIX = "mxnet_tpu/serving/"
+_ALLOWED_MARKERS = ("aot", "warmup", "compile", "lower", "load", "export",
+                    "manifest")
+_TRACE_TAILS = {"jit", "pjit", "eval_shape", "make_jaxpr", "functionalize"}
+
+
+def _allowed_scope(qualname):
+    return any(m in seg.lower() for seg in qualname.split(".")
+               for m in _ALLOWED_MARKERS)
+
+
+@register
+class ServingHotPath(Pass):
+    name = "serving-hot-path"
+    codes = {"MXT050": "trace-triggering call in the serving "
+                       "steady-state path"}
+
+    def run(self, ctx, mod):
+        if not mod.relpath.startswith(_SERVING_PREFIX):
+            return []
+        findings = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            head, _, tail = name.rpartition(".")
+            what = None
+            if tail in _TRACE_TAILS:
+                what = name
+            elif tail == "lower" and isinstance(node.func, ast.Attribute):
+                # only a jit-ish receiver: str.lower() must stay silent
+                if names_in(node.func.value) & {"jit", "jax", "pjit"}:
+                    what = name
+            if what is None:
+                continue
+            scope = mod.qualname(node)
+            if _allowed_scope(scope):
+                continue
+            findings.append(Finding(
+                code="MXT050", path=mod.relpath, line=node.lineno,
+                message=f"{what}() traces inside the serving steady-state "
+                        f"path ({scope})",
+                hint="AOT-compile at startup instead: move the trace into "
+                     "a *aot*/*warmup*/*compile*-named function and look "
+                     "the executable up by dispatch_cache.signature_key "
+                     "in the loop (zero-fresh-trace contract, ISSUE 8)",
+                scope=scope, key=f"serving-trace:{tail}",
+                col=node.col_offset))
+        return findings
