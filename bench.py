@@ -564,6 +564,111 @@ def _bench_zero_optimizer_bytes(dp):
             os.environ["MXNET_ZERO"] = prev
 
 
+def bench_planner():
+    """Sharding planner (ISSUE 10): plan-time overhead (one-time, host
+    only), the zero-per-step-cost contract (compile-tracer-asserted:
+    after the warmup step every further planner-driven step performs
+    ZERO fresh traces and zero plan work), and estimated-vs-actual HBM
+    bytes for the llama proxy under 2 mesh shapes."""
+    import time
+
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.gluon.model_zoo.language import llama
+    from mxnet_tpu.parallel import planner
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+    from mxnet_tpu.parallel.functional import functionalize
+
+    cfg = dict(vocab_size=512, hidden_size=128, num_layers=2,
+               num_heads=4, num_kv_heads=2, intermediate_size=256,
+               max_seq_len=256)
+    # the global batch shards over the data axes: keep it divisible by
+    # the device count on any mesh this arm builds
+    n_dev = len(jax.devices())
+    batch, seq = max(2, n_dev), 64
+
+    def make_net():
+        net = llama.LlamaForCausalLM(llama.LlamaConfig(**cfg))
+        net.initialize(ctx=mx.current_context())
+        net(mx.nd.zeros((1, seq), dtype="int32"))
+        return net
+
+    def loss_fn(logits, labels):
+        import jax.numpy as jnp
+
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[..., None], axis=-1)
+
+    def actual_resident_bytes(step):
+        """Measured per-device bytes of params + optimizer state (the
+        plan-governed resident footprint; grads/activations are
+        transient inside the donated jit)."""
+        total = 0
+        leaves = list(step.train_params.values()) \
+            + list(step.rest_params.values()) \
+            + jax.tree_util.tree_leaves(step.opt_state)
+        for leaf in leaves:
+            shard = leaf.sharding.shard_shape(leaf.shape)
+            total += int(np.prod(shard) or 1) * leaf.dtype.itemsize
+        return total
+
+    meshes = {"dp": {"dp": n_dev}}
+    if n_dev % 2 == 0 and n_dev > 1:
+        # dp*fsdp = n_dev here, so `batch` stays divisible; an odd
+        # device count has no even dp×fsdp split — skip the arm, keep
+        # the dp numbers
+        meshes["dp_fsdp"] = {"dp": n_dev // 2, "fsdp": 2}
+    out = {"device_count": n_dev}
+    ids = np.random.randint(0, cfg["vocab_size"],
+                            (batch, seq)).astype("int32")
+    labels = np.random.randint(0, cfg["vocab_size"],
+                               (batch, seq)).astype("int32")
+    for name, axes in meshes.items():
+        # one net per arm, planned from ITS OWN signature — plan specs
+        # key on param names, and gluon auto-name prefixes differ
+        # between net instances
+        net = make_net()
+        sig = planner.signature_of(functionalize(net)[1])
+        t0 = time.perf_counter()
+        plan = planner.plan_sharding(
+            planner.PlannerConfig(mesh=axes, rules="fsdp",
+                                  optimizer="sgd_momentum",
+                                  batch_rows=batch), sig, n_dev)
+        plan_ms = (time.perf_counter() - t0) * 1e3
+        step = TrainStep(net, loss_fn, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.01,
+                                           "momentum": 0.9}, plan=plan)
+        step(ids, labels)            # warmup: the one compile
+        before = telemetry.snapshot()["compile"]["count"]
+        iters = 4
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            step(ids, labels)
+        last = step(ids, labels)
+        np.asarray(last)             # drain async dispatch
+        dt = time.perf_counter() - t0
+        fresh = telemetry.snapshot()["compile"]["count"] - before
+        est = plan.hbm
+        actual = actual_resident_bytes(step)
+        est_resident = est["params"] + est["optimizer"]
+        out[name] = {
+            "plan_ms": round(plan_ms, 2),
+            "steady_steps_per_s": round((iters + 1) / dt, 2),
+            "fresh_traces_after_warmup": int(fresh),
+            "estimated_resident_bytes": int(est_resident),
+            "actual_resident_bytes": int(actual),
+            "estimate_ratio": round(actual / max(1, est_resident), 3),
+            "estimated_total_bytes": int(est["total"]),
+        }
+        assert fresh == 0, \
+            f"planner arm {name}: {fresh} fresh traces after warmup " \
+            "(the zero-per-step-cost contract is compile-tracer-asserted)"
+    return out
+
+
 def bench_serving():
     """Serving-engine load generator (ISSUE 8).
 
@@ -797,6 +902,13 @@ def main():
         extra["serving"] = bench_serving()
     except Exception as e:
         extra["serving"] = {"error": repr(e)[:200]}
+    try:
+        # sharding planner (ISSUE 10): one-time plan cost, the
+        # zero-per-step-cost pin, and the HBM model's estimated-vs-
+        # actual bytes under two mesh shapes
+        extra["planner"] = bench_planner()
+    except Exception as e:
+        extra["planner"] = {"error": repr(e)[:200]}
     try:
         # BASELINE binding metric: allreduce bandwidth (tools/bandwidth_
         # measure.py ≙ reference tools/bandwidth/measure.py).  The bus

@@ -1,0 +1,182 @@
+"""CI smoke for the sharding planner (ISSUE 10, `planner` lane).
+
+End-to-end through the PUBLIC surface on the 8-virtual-device CPU mesh:
+
+1. plan a 2-layer MLP and the llama proxy;
+2. **determinism across processes** — a child process re-plans from the
+   identical (config, signature, device count) inputs and must produce
+   the identical ``plan.digest()`` (the SPMD-peer contract);
+3. **HBM feasibility on synthetic budgets** — a roomy budget selects
+   pure dp, a tight one escalates to fsdp with the estimate under
+   budget, an impossible one raises;
+4. **visualize_sharding round trip** — ``plan.publish()`` →
+   ``telemetry.snapshot()`` → ``planner.report_from_snapshot`` equals
+   ``plan.report()``;
+5. a planner-driven TrainStep runs and its 3-step trajectory equals the
+   legacy param_sharding path bit for bit.
+"""
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, nd, telemetry  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.parallel import planner  # noqa: E402
+from mxnet_tpu.parallel.data_parallel import TrainStep  # noqa: E402
+from mxnet_tpu.parallel.functional import functionalize  # noqa: E402
+
+CHILD = "--child-digest"
+
+
+def mlp_signature():
+    from mxnet_tpu.gluon import block as _block
+
+    _block._NAME_SCOPE.counters.clear()
+    del _block._NAME_SCOPE.scope_stack[:]
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(8))
+    net.initialize()
+    net(nd.zeros((2, 32)))
+    return net, planner.signature_of(functionalize(net)[1])
+
+
+def llama_signature():
+    from mxnet_tpu.gluon.model_zoo.language import llama
+
+    cfg = llama.LlamaConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                            num_heads=4, num_kv_heads=2,
+                            intermediate_size=128, max_seq_len=64)
+    net = llama.LlamaForCausalLM(cfg)
+    net.initialize(ctx=mx.cpu())
+    net(mx.nd.zeros((1, 8), dtype="int32"))
+    return net, planner.signature_of(functionalize(net)[1])
+
+
+def plan_both(mlp_sig, llama_sig):
+    mlp_plan = planner.plan_sharding(
+        planner.PlannerConfig(mesh="auto", rules="fsdp",
+                              optimizer="sgd_momentum", batch_rows=64,
+                              hbm_gb=1.0), mlp_sig, 8)
+    llama_plan = planner.plan_sharding(
+        planner.PlannerConfig(mesh="auto", rules="megatron+fsdp",
+                              optimizer="adam", batch_rows=64,
+                              hbm_gb=1.0), llama_sig, 8)
+    return mlp_plan, llama_plan
+
+
+def main():
+    if CHILD in sys.argv:
+        # the determinism peer: same inputs, fresh process
+        _, mlp_sig = mlp_signature()
+        _, llama_sig = llama_signature()
+        a, b = plan_both(mlp_sig, llama_sig)
+        print(json.dumps({"mlp": a.digest(), "llama": b.digest()}))
+        return 0
+
+    net, mlp_sig = mlp_signature()
+    _, llama_sig = llama_signature()
+    mlp_plan, llama_plan = plan_both(mlp_sig, llama_sig)
+    print("[planner] mlp plan:", dict(mlp_plan.axes), "chosen_by",
+          mlp_plan.chosen_by)
+    print(llama_plan.visualize_sharding().splitlines()[0])
+
+    # 2) cross-process determinism
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), CHILD],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    child = json.loads(out.stdout.strip().splitlines()[-1])
+    assert child["mlp"] == mlp_plan.digest(), "mlp plan digest diverged"
+    assert child["llama"] == llama_plan.digest(), \
+        "llama plan digest diverged across processes"
+    print("[planner] cross-process digests identical")
+
+    # 3) synthetic HBM budgets
+    roomy, _, _ = planner.choose_mesh(
+        llama_sig, planner.named_rule_set("megatron+fsdp"), 8,
+        budget_bytes=1 << 34, optimizer="adam")
+    assert roomy == {"dp": 8, "fsdp": 1, "tp": 1, "pp": 1}, roomy
+    est_rep = planner.estimate(
+        llama_sig, planner.named_rule_set("replicated"), {"dp": 8},
+        optimizer="adam")
+    tight = int(est_rep["total"] * 0.6)
+    axes, est, trail = planner.choose_mesh(
+        llama_sig, planner.named_rule_set("megatron+fsdp"), 8,
+        budget_bytes=tight, optimizer="adam")
+    assert est["total"] <= tight and est["feasible"]
+    assert axes["fsdp"] > 1 or axes["tp"] > 1, axes
+    try:
+        planner.choose_mesh(llama_sig,
+                            planner.named_rule_set("megatron+fsdp"), 8,
+                            budget_bytes=4096, optimizer="adam")
+        raise AssertionError("impossible budget did not raise")
+    except MXNetError as e:
+        assert "HBM budget" in str(e)
+    print("[planner] feasibility: roomy->dp8, tight ->", dict(axes),
+          f"({est['total']}B <= {tight}B), impossible raises")
+
+    # 4) report round trip through the telemetry snapshot
+    rep = llama_plan.publish()
+    rt = planner.report_from_snapshot(telemetry.snapshot())
+    assert rt is not None
+    assert rt["axes"] == rep["axes"]
+    assert rt["components"] == rep["components"]
+    assert rt["feasible"] == rep["feasible"]
+    assert rt["budget_bytes"] == rep["budget_bytes"]
+    assert sorted((r["param"], r["spec"], r["bytes_per_device"])
+                  for r in rt["params"]) == \
+        sorted((r["param"], r["spec"], r["bytes_per_device"])
+               for r in rep["params"])
+    print("[planner] visualize_sharding report round-trips the snapshot")
+
+    # 5) planner TrainStep == legacy TrainStep, bit for bit
+    def ce(logits, labels):
+        import jax.numpy as jnp
+
+        return jnp.square(logits - labels).mean()
+
+    def run(step):
+        rng = np.random.RandomState(5)
+        return [float(np.asarray(step(rng.randn(8, 32).astype("f"),
+                                      rng.randn(8, 8).astype("f"))))
+                for _ in range(3)]
+
+    explicit = planner.plan_sharding(
+        planner.PlannerConfig(mesh={"dp": 4, "fsdp": 2}, rules="fsdp",
+                              optimizer="sgd_momentum"), mlp_sig, 8)
+    s1 = TrainStep(net, ce, optimizer="sgd",
+                   optimizer_params={"learning_rate": 0.1,
+                                     "momentum": 0.9}, plan=explicit)
+    net2, _ = mlp_signature()
+    s2 = TrainStep(net2, ce, optimizer="sgd",
+                   optimizer_params={"learning_rate": 0.1,
+                                     "momentum": 0.9},
+                   mesh=explicit.build_mesh(), param_sharding="fsdp")
+    a, b = run(s1), run(s2)
+    assert a == b, (a, b)
+    print("[planner] 3-step planner-vs-legacy trajectory bit-identical")
+    print("planner smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

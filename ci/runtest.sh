@@ -22,6 +22,12 @@
 #               bucket per step, byte accounting vs the non-ZeRO path,
 #               1/dp optimizer memory, collectives.allreduce fault ->
 #               one supervised restart) + the overlap/zero unit suites
+#   planner     sharding-planner smoke (plan a 2-layer MLP + the llama
+#               proxy on fake 8-device meshes; plan-digest determinism
+#               across two processes, HBM feasibility on synthetic
+#               budgets, visualize_sharding round trip through the
+#               telemetry snapshot, planner-vs-legacy TrainStep
+#               trajectory bit-identity) + the planner unit suite
 #   serving     inference-engine smoke (AOT warmup, 100 concurrent
 #               mixed-length HTTP requests with ZERO fresh traces,
 #               completions bit-matching the full-context forward,
@@ -121,6 +127,18 @@ case "$LANE" in
     JAX_PLATFORMS=cpu python -m pytest -q tests/test_overlap.py \
       tests/test_zero.py
     ;;
+  planner)
+    # 1) end-to-end smoke through the PUBLIC surface (ISSUE 10): plan
+    #    determinism across processes, HBM-budget mesh selection,
+    #    report round trip, planner-vs-legacy bit-identity
+    JAX_PLATFORMS=cpu python ci/planner_smoke.py
+    # 2) the unit suite (rule engine bit-compat, auto selection, ZeRO
+    #    elastic restore across planner meshes, planner-sharded serving
+    #    zero-trace pin).  The unit lane also runs this file; the repeat
+    #    is deliberate — the planner stage must stay green/triagable on
+    #    its own (~30s)
+    JAX_PLATFORMS=cpu python -m pytest -q tests/test_planner.py
+    ;;
   serving)
     # 1) end-to-end smoke through the PUBLIC surface: engine + HTTP on a
     #    free port, 4 concurrent clients x 25 mixed-length requests with
@@ -143,7 +161,7 @@ case "$LANE" in
     python bench.py | tee BENCH.json
     ;;
   *)
-    echo "unknown lane: $LANE (lint|unit|tpu|dist|chaos|telemetry|overlap|serving|sanity|nightly|bench)" >&2
+    echo "unknown lane: $LANE (lint|unit|tpu|dist|chaos|telemetry|overlap|planner|serving|sanity|nightly|bench)" >&2
     exit 2
     ;;
 esac

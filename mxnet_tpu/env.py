@@ -113,6 +113,18 @@ Wired vars (read at ``import mxnet_tpu``):
 - ``MXNET_SERVING_DEADLINE_MS``: default per-request deadline in ms
   covering queueing + generation (default 0 = none; per-request
   ``deadline_ms`` overrides).
+- ``MXNET_PLANNER_MESH``: default mesh for the sharding planner
+  (``auto`` or an explicit ``dp=4,tp=2`` spec — see
+  :mod:`mxnet_tpu.parallel.planner`).
+- ``MXNET_PLANNER_HBM_GB``: per-device HBM budget in GiB the planner's
+  auto mesh selection plans against (default 16.0; config, not probed,
+  so every SPMD peer selects the same mesh).
+- ``MXNET_PLANNER_PIPELINE_IN_JIT``: feed traced pipeline stage params
+  into shard_map with ``P(pp)`` in_specs instead of the jax-0.4.37
+  GSPMD replicated workaround (default 0; the ROADMAP "re-test after
+  jax upgrade" item is now this one flag).
+- ``MXNET_PLANNER_REPORT``: print the planner's ``visualize_sharding``
+  report whenever a plan is computed (default 0).
 - ``MXNET_SUBGRAPH_BACKEND``: subgraph backend applied automatically at
   Module bind time (see :mod:`mxnet_tpu.subgraph`; unset = none).
 - ``MXNET_NUM_WORKERS``: launcher-provided world size for
@@ -301,6 +313,37 @@ def serving_deadline_ms():
     return max(0, get_int("MXNET_SERVING_DEADLINE_MS", 0))
 
 
+def planner_mesh():
+    """Default mesh for PlannerConfig(mesh=None): "auto" or an explicit
+    "dp=4,tp=2"-style spec (MXNET_PLANNER_MESH, default auto;
+    parallel/planner)."""
+    return get_str("MXNET_PLANNER_MESH", "auto")
+
+
+def planner_hbm_gb():
+    """Per-device HBM budget in GiB for the planner's auto mesh
+    selection (MXNET_PLANNER_HBM_GB, default 16.0 — a v5e-class chip;
+    the budget is config, not probed, so every SPMD peer plans against
+    the same number)."""
+    v = get_float("MXNET_PLANNER_HBM_GB", 16.0)
+    return v if v > 0 else 16.0
+
+
+def planner_pipeline_in_jit():
+    """Use P(pp) in_specs for traced pipeline stage params instead of
+    the jax-0.4.37 GSPMD replicated workaround
+    (MXNET_PLANNER_PIPELINE_IN_JIT, default 0 — flip after a jax
+    upgrade proves the weight-stationary in-jit sharding correct; see
+    parallel/pipeline_parallel.py)."""
+    return get_bool("MXNET_PLANNER_PIPELINE_IN_JIT", False)
+
+
+def planner_report():
+    """Print the visualize_sharding report whenever a plan is computed
+    (MXNET_PLANNER_REPORT, default 0)."""
+    return get_bool("MXNET_PLANNER_REPORT", False)
+
+
 def describe():
     """One line per known var: current value and what it maps to."""
     lines = []
@@ -376,6 +419,15 @@ def describe():
          "(default 16)"),
         ("MXNET_SERVING_DEADLINE_MS", "default per-request serving "
          "deadline in ms (default 0 = none)"),
+        ("MXNET_PLANNER_MESH", "default planner mesh: auto or "
+         "\"dp=4,tp=2\"-style spec (parallel/planner)"),
+        ("MXNET_PLANNER_HBM_GB", "per-device HBM budget in GiB for "
+         "planner auto mesh selection (default 16.0)"),
+        ("MXNET_PLANNER_PIPELINE_IN_JIT", "P(pp) in_specs for traced "
+         "pipeline stage params instead of the GSPMD replicated "
+         "workaround (default 0; flip after a jax upgrade)"),
+        ("MXNET_PLANNER_REPORT", "print the visualize_sharding report "
+         "at plan time (default 0)"),
         ("MXNET_SUBGRAPH_BACKEND", "subgraph backend applied at Module "
          "bind time (mxnet_tpu.subgraph; unset = none)"),
         ("MXNET_NUM_WORKERS", "launcher world size for distributed.init "

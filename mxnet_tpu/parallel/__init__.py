@@ -17,6 +17,9 @@ north star requires (TP/FSDP/SP that MXNet 1.x never had):
 - pipeline_parallel: GPipe schedule over the pp axis (weight-stationary
                    stages, ppermute activation passing, differentiable)
 - expert_parallel: switch-MoE layer with GSPMD all_to_all over ep
+- planner:         the sharding planner — logical-axis rules + HBM-model
+                   mesh auto-selection → one ShardingPlan every sharded
+                   consumer (TrainStep / pipeline / ZeRO / serving) reads
 """
 from . import mesh
 from . import collectives
@@ -24,6 +27,7 @@ from . import distributed
 from . import tensor_parallel
 from . import pipeline_parallel
 from . import expert_parallel
+from . import planner
 from .mesh import make_mesh, get_default_mesh, set_default_mesh
 from .context_parallel import (ring_attention,
                                context_parallel_attention,
@@ -37,4 +41,5 @@ __all__ = ["mesh", "collectives", "distributed", "tensor_parallel",
            "ring_attention", "context_parallel_attention",
            "ulysses_attention", "ulysses_context_parallel_attention",
            "pipeline_parallel", "expert_parallel", "pipeline_apply",
-           "stack_stage_params", "moe_apply", "stack_expert_params"]
+           "stack_stage_params", "moe_apply", "stack_expert_params",
+           "planner"]

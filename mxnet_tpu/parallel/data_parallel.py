@@ -88,30 +88,35 @@ def make_adam_update(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, wd=0.0):
 
 
 # --------------------------------------------------------------------------
-# sharding spec builders
+# sharding spec builders — thin shims over the planner's rule engine
+# (parallel/planner owns the heuristics; these keep the original API)
 # --------------------------------------------------------------------------
 def replicated_specs(params):
     from jax.sharding import PartitionSpec as P
 
-    return OrderedDict((k, P()) for k in params)
+    from .planner.rules import named_rule_set
+
+    rs = named_rule_set("replicated")
+    return OrderedDict((k, P(*rs.spec_for(k, getattr(v, "shape", ()),
+                                          {})))
+                       for k, v in params.items())
 
 
 def fsdp_specs(params, mesh, axis="fsdp"):
-    """Shard each parameter's largest divisible dim over the fsdp axis
-    (ZeRO-3 layout); fall back to replication for small/indivisible params."""
+    """Shard each parameter's first evenly-divisible dim over the fsdp
+    axis (ZeRO-3 layout); replication for small/indivisible params.
+    Delegates to the planner's shape heuristic — the planner must
+    reproduce this hand-wired layout bit-identically, so there is
+    exactly one implementation."""
     from jax.sharding import PartitionSpec as P
 
-    n = mesh.shape[axis]
-    specs = OrderedDict()
-    for k, v in params.items():
-        spec = P()
-        if n > 1:
-            for d, size in enumerate(v.shape):
-                if size % n == 0 and size >= n:
-                    spec = P(*([None] * d + [axis]))
-                    break
-        specs[k] = spec
-    return specs
+    from .planner.rules import RuleSet
+
+    rs = RuleSet(heuristic_axis=axis, name="fsdp")
+    sizes = dict(mesh.shape)
+    return OrderedDict(
+        (k, P(*rs.spec_for(k, v.shape, sizes)))
+        for k, v in params.items())
 
 
 class TrainStep:
@@ -129,14 +134,24 @@ class TrainStep:
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, param_sharding="replicated", extra_param_specs=None,
                  batch_axes=("dp", "fsdp"), donate=True, train_mode=True,
-                 dtype=None, pipeline=None, remat=False):
+                 dtype=None, pipeline=None, remat=False, plan=None):
         """``pipeline``: dict enabling pipeline parallelism over a mesh
         axis — {'num_microbatches': M, 'axis': 'pp', 'schedule':
         'gpipe'|'1f1b', 'remat_stage': bool}.  The net must implement
         ``pipeline_decompose(n_stages, train_mode)`` (the model zoo's
         LlamaForCausalLM does): heterogeneous embed/head ends run outside
         the pipe, the homogeneous trunk streams over pp, and dp/fsdp
-        batch axes compose with it in the same jit."""
+        batch axes compose with it in the same jit.
+
+        ``plan``: a :class:`~mxnet_tpu.parallel.planner.ShardingPlan` —
+        the planner-native entry.  Supplies the mesh (built from the
+        plan's axes when ``mesh`` is not also given), every parameter's
+        PartitionSpec, the batch spec, and the pipeline in-jit-sharding
+        flag; ``param_sharding`` is ignored (``extra_param_specs`` still
+        applies last, as the per-call escape hatch).  Without ``plan``,
+        the legacy string/dict modes are themselves routed through the
+        planner (``ShardingPlan.from_specs``), so every sharded
+        TrainStep now has exactly one audited layout object."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -146,6 +161,20 @@ class TrainStep:
                 "TrainStep(remat=True) does not compose with pipeline=; "
                 "use pipeline={'remat_stage': True} for per-stage "
                 "rematerialization inside the pipe")
+        # plan-first resolution: the plan supplies mesh and batch axes
+        # BEFORE the pipeline block filters them
+        self._plan = plan
+        if plan is not None:
+            if mesh is None:
+                mesh = plan.build_mesh()
+            else:
+                for ax, size in plan.axes.items():
+                    if size != mesh.shape.get(ax, 1):
+                        raise MXNetError(
+                            f"plan axis {ax}={size} does not match the "
+                            f"mesh ({dict(mesh.shape)}) — build the mesh "
+                            "with plan.build_mesh() or re-plan")
+            batch_axes = tuple(plan.batch_axes)
         self._net = net
         apply_fn, params = functionalize(net, train_mode=train_mode,
                                          with_state=train_mode)
@@ -206,22 +235,49 @@ class TrainStep:
             raise MXNetError(f"TrainStep optimizer {optimizer!r} not supported "
                              "(use 'sgd' or 'adam', or the imperative Trainer)")
 
+        from . import planner as _planner
+
         self._mesh = mesh
         if mesh is not None:
-            if param_sharding == "fsdp":
-                specs = fsdp_specs(params, mesh)
-            elif param_sharding == "replicated":
-                specs = replicated_specs(params)
-            elif isinstance(param_sharding, dict):
-                specs = OrderedDict(
-                    (k, param_sharding.get(k, P())) for k in params)
-            else:
-                raise MXNetError(f"bad param_sharding {param_sharding!r}")
+            if plan is None:
+                # legacy modes: resolve exactly as before, then wrap as
+                # a plan — one audited layout object either way
+                if param_sharding == "fsdp":
+                    specs = fsdp_specs(params, mesh)
+                elif param_sharding == "replicated":
+                    specs = replicated_specs(params)
+                elif isinstance(param_sharding, dict):
+                    specs = OrderedDict(
+                        (k, param_sharding.get(k, P())) for k in params)
+                else:
+                    raise MXNetError(
+                        f"bad param_sharding {param_sharding!r}")
+                plan = _planner.ShardingPlan.from_specs(
+                    dict(mesh.shape), specs, batch_axes,
+                    _planner.signature_of(params),
+                    optimizer=("adam" if optimizer == "adam" else
+                               ("sgd_momentum"
+                                if opt_params.get("momentum") else "sgd")))
+            missing = [k for k in params if k not in plan.specs]
+            if missing and plan.specs:
+                # a plan keyed on a DIFFERENT net instance's auto-names
+                # would silently replicate everything — make it loud
+                import warnings
+
+                warnings.warn(
+                    f"sharding plan covers none of/only part of this "
+                    f"net's params ({len(missing)}/{len(params)} "
+                    f"missing, e.g. {missing[0]!r}); missing params "
+                    "replicate. Re-plan from THIS net's signature "
+                    "(planner.signature_of) — gluon auto-name prefixes "
+                    "differ between instances.", stacklevel=2)
+            specs = plan.partition_specs(params.keys())
             if extra_param_specs:
                 specs.update(extra_param_specs)
+            self._plan = plan
             self._param_shard = OrderedDict(
                 (k, NamedSharding(mesh, s)) for k, s in specs.items())
-            self._batch_shard = NamedSharding(mesh, P(batch_axes))
+            self._batch_shard = NamedSharding(mesh, plan.batch_spec())
             # copy first: device_put returns the SAME buffer when the target
             # sharding already matches (1-device mesh, replicated params), and
             # jit donation below would then invalidate the Gluon net's own
@@ -263,6 +319,12 @@ class TrainStep:
 
         pipeline_cfg = self._pipeline
         mesh_ = mesh
+        # planner flag: keep the jax-0.4.37 GSPMD replicated workaround
+        # unless the plan (or MXNET_PLANNER_PIPELINE_IN_JIT) asks for
+        # true in-jit P(pp) stage sharding (ROADMAP "re-test after jax
+        # upgrade" is now a config flip, not a code hunt)
+        pipe_in_jit = self._plan.pipeline_in_jit_sharding \
+            if self._plan is not None else None
 
         def pipelined_forward(p, rng, x):
             from .pipeline_parallel import pipeline_apply, stack_stage_params
@@ -307,7 +369,8 @@ class TrainStep:
                 axis=pipeline_cfg["axis"],
                 schedule=pipeline_cfg["schedule"],
                 remat_stage=pipeline_cfg["remat_stage"],
-                batch_axes=pipeline_cfg["batch_axes"])
+                batch_axes=pipeline_cfg["batch_axes"],
+                in_jit_sharding=pipe_in_jit)
             return d["post_fn"]({k: p[k] for k in d["post_names"]}, rng, h)
 
         def step(train_params, rest_params, opt_state, rng, x, y):

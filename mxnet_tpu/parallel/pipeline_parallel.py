@@ -44,7 +44,7 @@ def stack_stage_params(per_stage_params):
 
 def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
                    axis="pp", remat_stage=False, schedule="gpipe",
-                   batch_axes=()):
+                   batch_axes=(), in_jit_sharding=None):
     """Run ``stage_fn`` as an S-stage pipeline over the mesh's pp axis.
 
     stage_fn(params_one_stage, x_mb) -> y_mb, where y_mb has x_mb's shape
@@ -54,13 +54,29 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
     x: global batch, leading dim divisible by num_microbatches (and by
     the product of ``batch_axes`` mesh axes, which shard it).
     Returns stage_{S-1}(...stage_0(x)) with x's sharding.
+
+    ``in_jit_sharding`` selects the layout of TRACED stage params (the
+    TrainStep path, where the stacked tree is built inside an outer
+    jit): False/None = the replicated workaround for the jax-0.4.37
+    GSPMD miscompile (see below); True = true weight-stationary
+    ``P(pp)`` in_specs — flip via the planner
+    (``ShardingPlan.pipeline_in_jit_sharding`` /
+    ``MXNET_PLANNER_PIPELINE_IN_JIT``) once a jax upgrade proves it
+    correct on multi-axis meshes.  Concrete (non-traced) stage params
+    are always placed weight-stationary; stage specs come from the
+    planner (:func:`planner.rules.stage_spec`).
     """
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from .collectives import shard_map
+    from .planner.rules import stage_spec
 
+    if in_jit_sharding is None:
+        from .. import env as _env
+
+        in_jit_sharding = _env.planner_pipeline_in_jit()
     if schedule not in ("gpipe", "1f1b"):
         raise MXNetError(f"unknown pipeline schedule {schedule!r}")
     S = mesh.shape[axis]
@@ -76,11 +92,13 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
             f"pp axis size {S} (one stage per device)")
 
     def leaf_spec(leaf):
-        return P(axis, *([None] * (leaf.ndim - 1)))
+        # planner-owned stage layout: leading stage dim over pp
+        return P(*stage_spec(leaf.ndim, axis))
 
     traced = any(isinstance(leaf, jax.core.Tracer)
                  for leaf in jax.tree_util.tree_leaves(stage_params))
-    if traced:
+    replicated_in = traced and not in_jit_sharding
+    if replicated_in:
         # Inside an outer jit (TrainStep): the stage params were stacked
         # by TRACED ops, and feeding that product into shard_map with a
         # P(axis) spec miscompiles under GSPMD when the mesh carries
@@ -92,13 +110,21 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
         # (P()) and let each device gather its own stage by axis index
         # inside the body.  Memory is unchanged for the TrainStep path —
         # its source params are replicated storage anyway.
+        # ``in_jit_sharding=True`` (planner flag) restores the
+        # weight-stationary P(axis) specs — re-test after a jax upgrade;
+        # on real pods it avoids holding every stage's params per device
+        # inside the pipe region.
         pspecs = jax.tree_util.tree_map(lambda leaf: P(), stage_params)
     else:
         pspecs = jax.tree_util.tree_map(leaf_spec, stage_params)
-        stage_params = jax.tree_util.tree_map(
-            lambda leaf, spec: jax.device_put(leaf,
-                                              NamedSharding(mesh, spec)),
-            stage_params, pspecs)
+        if not traced:
+            # concrete params: place weight-stationary up front
+            # (tracers cannot be device_put — in-jit sharding rides the
+            # in_specs alone)
+            stage_params = jax.tree_util.tree_map(
+                lambda leaf, spec: jax.device_put(
+                    leaf, NamedSharding(mesh, spec)),
+                stage_params, pspecs)
 
     if remat_stage:
         # gpipe: AD recomputes per-tick; 1f1b: bounds the intra-stage
@@ -144,7 +170,7 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, num_microbatches,
         return jax.lax.psum(outputs, axis), saved
 
     def pp_fn(params_local, xs):
-        if traced:
+        if replicated_in:
             # replicated-in params: each device selects its stage (the
             # gather's transpose scatter-adds grads back to the right
             # stage slice, so AD composes)

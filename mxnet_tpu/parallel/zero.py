@@ -26,6 +26,12 @@ when ``MXNET_ZERO=1``):
   per-rank shard layout is :func:`bucketing.shard_layout` — flat size
   padded to dp-divisible, contiguous rank shards — and is a pure
   function of (bucket size, dp), so every peer computes the same shards.
+  The shard COUNT itself derives from the sharding planner when a plan
+  governs the engine (``ZeroBucketEngine(opt, plan=...)`` or the
+  session default via ``planner.set_default_plan``): ``dp`` =
+  ``ShardingPlan.zero_shards`` (the plan's data-parallel degree), so an
+  elastic restore onto a different planner-chosen mesh is first-class —
+  the payload below was already dp-agnostic.
 - optimizer state is keyed by **(plan generation, bucket index)** —
   exactly like the 2-bit compression residual keys — so a replan can
   never alias state across different bucket compositions.  On a
@@ -114,7 +120,7 @@ class ZeroBucketEngine:
     params/store exactly like a pulled bucket).
     """
 
-    def __init__(self, optimizer):
+    def __init__(self, optimizer, plan=None):
         kind = kind_of(optimizer)
         if kind is None:
             raise MXNetError(
@@ -123,6 +129,16 @@ class ZeroBucketEngine:
                 f"{sorted(_SUPPORTED)})")
         self.optimizer = optimizer
         self._kind = kind
+        # shard layout source: an explicit ShardingPlan, else the
+        # session default plan (planner.set_default_plan), else the
+        # pre-planner behavior (1/dp over every device).  The payload
+        # stays dp-agnostic either way — a checkpoint saved under one
+        # plan restores onto any other (elastic-resume contract).
+        if plan is None:
+            from .planner import get_default_plan
+
+            plan = get_default_plan()
+        self._plan = plan
         # (generation tag, bucket index) -> {"leaves", "members", "size",
         # "dtype"}; leaves are global arrays sharded P("dp").  The
         # generation tag is any hashable the CALLER derives from its plan
@@ -149,16 +165,26 @@ class ZeroBucketEngine:
         from jax.sharding import Mesh
 
         if self._mesh is None:
-            self._mesh = Mesh(_np.array(jax.devices()), ("dp",))
+            self._mesh = Mesh(_np.array(jax.devices()[:self.dp]), ("dp",))
         return self._mesh
 
     @property
     def dp(self):
-        """Shard count: the full device mesh (every device owns 1/dp of
-        every bucket's optimizer state)."""
+        """Shard count.  From the plan's data-parallel degree
+        (``ShardingPlan.zero_shards``) when a plan governs this engine;
+        otherwise the full device mesh (every device owns 1/dp of every
+        bucket's optimizer state — the pre-planner layout, which a
+        full-device dp plan reproduces exactly).  Multi-process jobs
+        always use the full mesh: the elastic sub-device plan is a
+        single-process concept — ``_contributions`` builds one
+        ``n_local``-row block per process, so a mesh missing some
+        processes' devices could not place the contribution stack."""
         import jax
 
-        return len(jax.devices())
+        n = len(jax.devices())
+        if self._plan is not None and jax.process_count() == 1:
+            return max(1, min(n, self._plan.zero_shards))
+        return n
 
     def _place(self, host, spec):
         """Place a host array as a global array with PartitionSpec
@@ -283,6 +309,18 @@ class ZeroBucketEngine:
         sharding = NamedSharding(mesh, P("dp", None))
         n_total = self.dp
         n_local = jax.local_device_count()
+        grad_flats = list(grad_flats)
+        if len(grad_flats) > n_total:
+            # a plan with fewer zero shards than device slots (elastic
+            # restore onto a smaller plan): fold the overflow
+            # contributions into the first rows — the reduce-scatter
+            # sums every row anyway, so the total is unchanged
+            base, extra = grad_flats[:n_total], grad_flats[n_total:]
+            for j, f in enumerate(extra):
+                k = j % n_total
+                base[k] = jnp.asarray(base[k], dtype) + \
+                    jnp.asarray(f, dtype)
+            grad_flats = base
         if jax.process_count() == 1:
             rows = [jnp.pad(jnp.asarray(f, dtype),
                             (0, padded - f.size)).reshape(1, padded)
@@ -404,7 +442,11 @@ class ZeroBucketEngine:
         opt = self.optimizer
         keys = list(bucket.keys) if opt_keys is None else list(opt_keys)
         dtype = _np.dtype(bucket.dtype)
-        padded, shard, _pad = _bucketing.shard_layout(bucket.size, self.dp)
+        # one layout source: shard_layout(size, dp) with dp already
+        # plan-derived via the ``dp`` property (ShardingPlan.
+        # shard_layout is the same pure function for external callers)
+        padded, shard, _pad = _bucketing.shard_layout(bucket.size,
+                                                      self.dp)
         state_key = (generation, bucket.index)
         entry = self._state.get(state_key)
         if entry is None:

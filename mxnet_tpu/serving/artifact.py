@@ -151,7 +151,7 @@ class LoadedArtifact:
     — the tracer makes the contract violation visible instead of
     silently retracing."""
 
-    def __init__(self, block, manifest, path):
+    def __init__(self, block, manifest, path, plan=None):
         self.block = block
         self.manifest = manifest
         self.path = path
@@ -163,6 +163,20 @@ class LoadedArtifact:
         names = block._input_names + block._sym_param_names
         self._param_vals = [block.params.get(n).data()._get()
                             for n in block._sym_param_names]
+        # planner-sharded AOT (tensor-parallel serving): place the
+        # frozen params per the plan once; every signature then compiles
+        # against the sharded avals (zero-fresh-trace contract intact)
+        self._plan = plan
+        self._rep_sharding = None
+        if plan is not None:
+            import jax
+
+            mesh = plan.build_mesh()
+            self._rep_sharding = plan.replicated(mesh)
+            self._param_vals = [
+                jax.device_put(v, plan.sharding(n, mesh))
+                for n, v in zip(block._sym_param_names,
+                                self._param_vals)]
         heads = block._sym._heads
 
         from ..symbol.symbol import evaluate
@@ -191,10 +205,20 @@ class LoadedArtifact:
         key = self._sig_key(avals)
         if key in self._exec:
             return self._exec[key]
-        key_aval = jax.ShapeDtypeStruct((2,), _np.uint32)
-        p_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+        rep = self._rep_sharding
+        key_aval = jax.ShapeDtypeStruct((2,), _np.uint32, sharding=rep) \
+            if rep is not None else jax.ShapeDtypeStruct((2,), _np.uint32)
+        p_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                        sharding=getattr(v, "sharding",
+                                                         None))
+                   if rep is not None else
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)
                    for v in self._param_vals]
         in_avals = [jax.ShapeDtypeStruct(tuple(a.shape),
+                                         _np.dtype(a.dtype),
+                                         sharding=rep)
+                    if rep is not None else
+                    jax.ShapeDtypeStruct(tuple(a.shape),
                                          _np.dtype(a.dtype))
                     for a in avals]
         compiled = jax.jit(self._pure).lower(
@@ -226,6 +250,12 @@ class LoadedArtifact:
         if compiled is None:
             compiled = self._aot_compile_signature(vals,
                                                    "steady_state_miss")
+        if self._rep_sharding is not None:
+            # the sharded executable needs every operand on the plan's
+            # mesh; committed single-device NDArrays do not auto-reshard
+            import jax
+
+            vals = [jax.device_put(v, self._rep_sharding) for v in vals]
         out = compiled(self._zero_key, *vals, *self._param_vals)
         ctx = args[0].context if args and isinstance(args[0], NDArray) \
             else current_context()
@@ -234,11 +264,14 @@ class LoadedArtifact:
         return NDArray._from_jax(out, ctx)
 
 
-def load_artifact(path, ctx=None, warm=True):
+def load_artifact(path, ctx=None, warm=True, plan=None):
     """Load an exported artifact back: manifest + symbol + params ->
     hybridized SymbolBlock, AOT-warmed across the manifest signatures
     (``warm=False`` skips the warmup).  Outputs are identical to the
-    exporting block's."""
+    exporting block's.  ``plan``: a
+    :class:`~mxnet_tpu.parallel.planner.ShardingPlan` — params are
+    placed per the plan's PartitionSpecs and every signature
+    AOT-compiles sharded (tensor-parallel serving)."""
     mpath = manifest_path(path)
     if not os.path.exists(mpath):
         raise MXNetError(
@@ -256,7 +289,7 @@ def load_artifact(path, ctx=None, warm=True):
     block = SymbolBlock.imports(sym_file, manifest["input_names"],
                                 params_file, ctx)
     block.hybridize()
-    art = LoadedArtifact(block, manifest, path)
+    art = LoadedArtifact(block, manifest, path, plan=plan)
     if warm:
         art.warmup()
     return art

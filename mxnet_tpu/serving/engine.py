@@ -112,7 +112,7 @@ class ServingEngine:
 
     def __init__(self, net, *, batch_buckets=None, prefill_buckets=None,
                  kv_pages=None, page_size=None, queue_bound=None,
-                 max_batch=None, deadline_ms=None, name=None):
+                 max_batch=None, deadline_ms=None, name=None, plan=None):
         from ..gluon.model_zoo.language.llama import (LlamaForCausalLM,
                                                       serving_params)
 
@@ -126,6 +126,23 @@ class ServingEngine:
         self._cfg = cfg
         self._name = name or "llama"
         self._params = dict(serving_params(net))
+        # tensor-parallel serving (ROADMAP serving follow-on (a)): a
+        # ShardingPlan places the frozen params once at construction and
+        # every prefill/decode/sample executable AOT-compiles against
+        # the sharded avals — steady state still performs zero fresh
+        # traces, GSPMD owns the collectives.  plan=None keeps the
+        # single-device layout bit-for-bit.
+        self._plan = plan
+        self._serve_mesh = None
+        self._rep_sharding = None
+        if plan is not None:
+            import jax
+
+            self._serve_mesh = plan.build_mesh()
+            self._rep_sharding = plan.replicated(self._serve_mesh)
+            self._params = {
+                k: jax.device_put(v, plan.sharding(k, self._serve_mesh))
+                for k, v in self._params.items()}
         self._batch_buckets = list(batch_buckets) if batch_buckets else \
             parse_buckets(_env.serving_batch_buckets(), "batch bucket")
         self._prefill_buckets = list(prefill_buckets) if prefill_buckets \
@@ -338,20 +355,45 @@ class ServingEngine:
         with self._lock:
             if key in self._exec:
                 return self._exec[key]
-        param_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
-                       for k, v in self._params.items()}
-        pool_aval = jax.ShapeDtypeStruct(self._kv.k_pool.shape,
-                                         self._kv.k_pool.dtype)
+        if self._plan is not None:
+            # planner-sharded AOT: params carry their NamedSharding from
+            # the placement at construction; pools and dynamic operands
+            # replicate over the same mesh (every executable input must
+            # live on one device set)
+            rep = self._rep_sharding
+            param_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                                   sharding=v.sharding)
+                           for k, v in self._params.items()}
+            pool_aval = jax.ShapeDtypeStruct(self._kv.k_pool.shape,
+                                             self._kv.k_pool.dtype,
+                                             sharding=rep)
+            dyn = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                             sharding=rep) for a in dyn)
+        else:
+            param_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                           for k, v in self._params.items()}
+            pool_aval = jax.ShapeDtypeStruct(self._kv.k_pool.shape,
+                                             self._kv.k_pool.dtype)
+        # planner path: pin every output replicated — with a tp plan the
+        # lm_head leaves logits vocab-sharded, and the sample executable
+        # (plus the host-side token fetch) expects the full row; the
+        # all-gather GSPMD inserts here is exactly tensor-parallel
+        # serving's logits gather before sampling
+        jit_kw = {} if self._plan is None else \
+            {"out_shardings": self._rep_sharding}
         if phase == "prefill":
             body = self._prefill_body(dims["L"], dims["P"])
-            lowered = jax.jit(body, donate_argnums=(1, 2)).lower(
+            lowered = jax.jit(body, donate_argnums=(1, 2),
+                              **jit_kw).lower(
                 param_avals, pool_aval, pool_aval, *dyn)
         elif phase == "decode":
             body = self._decode_body(dims["B"], dims["P"])
-            lowered = jax.jit(body, donate_argnums=(1, 2)).lower(
+            lowered = jax.jit(body, donate_argnums=(1, 2),
+                              **jit_kw).lower(
                 param_avals, pool_aval, pool_aval, *dyn)
         else:
-            lowered = jax.jit(self._sample_body(dims["B"])).lower(*dyn)
+            lowered = jax.jit(self._sample_body(dims["B"]),
+                              **jit_kw).lower(*dyn)
         compiled = lowered.compile()
         with self._lock:
             self._exec[key] = compiled
@@ -397,6 +439,16 @@ class ServingEngine:
         """AOT-compile the manifest and start the engine loop thread."""
         if self._thread is not None:
             return self
+        if self._plan is not None:
+            # the executables expect every operand on the plan's mesh:
+            # replicate the KV pools once up front (they stay replicated
+            # through the donate round trip, so this is one-time work)
+            import jax
+
+            self._kv.k_pool = jax.device_put(self._kv.k_pool,
+                                             self._rep_sharding)
+            self._kv.v_pool = jax.device_put(self._kv.v_pool,
+                                             self._rep_sharding)
         self._aot_warmup()
         self._thread = threading.Thread(target=self._run_loop,
                                         name="mxnet-serving-engine",

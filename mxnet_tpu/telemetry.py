@@ -231,6 +231,19 @@ class _Family:
     def cumulative(self):
         return self._children[()].cumulative()
 
+    def remove(self, *values, **kw):
+        """Drop one labeled child (stale-series cleanup — e.g. a
+        re-published sharding plan's obsolete per-param rows; no-op when
+        the label set was never created)."""
+        if kw:
+            if values:
+                raise ValueError("pass labels positionally or by name")
+            values = tuple(str(kw[n]) for n in self.labelnames)
+        else:
+            values = tuple(str(v) for v in values)
+        with _LOCK:
+            self._children.pop(values, None)
+
     def children(self):
         with _LOCK:
             return list(self._children.items())

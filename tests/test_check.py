@@ -82,12 +82,12 @@ def test_pass_catalog_complete():
     assert set(passes) == {"collective-safety", "collective-pairing",
                            "host-sync-hot-path", "lock-thread-hygiene",
                            "env-knob-registry", "fault-seam-integrity",
-                           "serving-hot-path"}
+                           "serving-hot-path", "planner-sharding"}
     all_codes = {c for cls in passes.values() for c in cls.codes}
     assert all_codes == {"MXT001", "MXT002", "MXT003", "MXT005",
                          "MXT006", "MXT010", "MXT020", "MXT021",
                          "MXT022", "MXT030", "MXT031", "MXT032",
-                         "MXT040", "MXT050"}
+                         "MXT040", "MXT050", "MXT060"}
 
 
 def test_parse_error_reported_not_fatal(tmp_path):
@@ -443,6 +443,70 @@ def test_mxt050_noqa_waiver(tmp_path):
             return jax.jit(body)
         """)
     assert codes_at(check(tmp_path), "MXT050") == []
+
+
+# -- MXT060 planner sharding -------------------------------------------------
+def test_mxt060_raw_sharding_outside_parallel(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/rogue.py", """
+        import jax.sharding
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def stage(mesh, x):
+            s = NamedSharding(mesh, P("dp"))           # lines 6 (x2)
+            return jax.sharding.PartitionSpec("tp"), s # line 7
+        """)
+    # `from jax import sharding as sh`: the alias IS the module
+    put(tmp_path, "mxnet_tpu/rogue2.py", """
+        from jax import sharding as sh
+
+        def stage(mesh):
+            return sh.NamedSharding(mesh, sh.PartitionSpec("dp"))
+        """)
+    # a local P in a module that does NOT import the spec alias stays
+    # silent (the serving engine's page-count locals, e.g.)
+    put(tmp_path, "mxnet_tpu/quiet.py", """
+        def pages(bucket_for, n):
+            P = bucket_for(n)
+            return P
+        """)
+    hits = codes_at(check(tmp_path), "MXT060")
+    assert ("mxnet_tpu/rogue.py", 6) in hits
+    assert ("mxnet_tpu/rogue.py", 7) in hits
+    rogue2 = [h for h in hits if h[0] == "mxnet_tpu/rogue2.py"]
+    assert len(rogue2) == 2, rogue2  # sh.NamedSharding + sh.PartitionSpec
+    assert len(hits) == 5
+    assert not any(p == "mxnet_tpu/quiet.py" for p, _ in hits)
+
+
+def test_mxt060_parallel_package_and_helpers_exempt(tmp_path):
+    mini_repo(tmp_path)
+    # inside mxnet_tpu/parallel/: constructions are the implementation
+    put(tmp_path, "mxnet_tpu/parallel/planner/plan.py", """
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def sharding(mesh, spec):
+            return NamedSharding(mesh, PartitionSpec(*spec))
+        """)
+    # outside: consuming the plan's helpers is the sanctioned route
+    put(tmp_path, "mxnet_tpu/consumer.py", """
+        def place(plan, mesh, params):
+            return {k: plan.sharding(k, mesh) for k in params}
+        """)
+    assert codes_at(check(tmp_path), "MXT060") == []
+
+
+def test_mxt060_noqa_waiver(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/special.py", """
+        from jax.sharding import PartitionSpec as P
+
+        def pinned():
+            # mxtpu: noqa[MXT060] testing the raw primitive on purpose
+            return P("dp")
+        """)
+    assert codes_at(check(tmp_path), "MXT060") == []
 
 
 # -- MXT020-022 lock/thread hygiene -----------------------------------------

@@ -164,6 +164,7 @@ def test_llama_trainstep_four_axis_mesh_composition():
     specs = fsdp_specs(params0, mesh)
     for name in params0:
         if name.endswith("lm_head_weight"):
+            # mxtpu: noqa[MXT060] tests the raw param_sharding dict entry
             specs[name] = P("tp", None)  # column-parallel head
     step = TrainStep(
         net, _lm_loss, optimizer="adam",

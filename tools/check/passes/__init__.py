@@ -5,12 +5,14 @@ subclass decorated with ``@register``, then import it below.  Codes are
 namespaced by decade: MXT00x collective-safety (001-003 general,
 005-006 reduce-scatter pairing / bucket keying), MXT01x hot-path,
 MXT02x lock/thread, MXT03x env knobs, MXT04x fault seams, MXT05x
-serving steady-state (no traces outside AOT warmup).
+serving steady-state (no traces outside AOT warmup), MXT06x sharding
+planner (no raw PartitionSpec/NamedSharding outside mxnet_tpu/parallel/).
 """
 from . import collectives  # noqa: F401
 from . import envknobs  # noqa: F401
 from . import faultseams  # noqa: F401
 from . import hotpath  # noqa: F401
 from . import pairing  # noqa: F401
+from . import planner  # noqa: F401
 from . import serving  # noqa: F401
 from . import threads  # noqa: F401

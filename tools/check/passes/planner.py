@@ -1,0 +1,110 @@
+"""MXT060: raw sharding construction outside ``mxnet_tpu/parallel/``.
+
+The sharding planner (ISSUE 10, ``mxnet_tpu/parallel/planner/``) exists
+so layout decisions live in ONE audited place: a ``ShardingPlan`` built
+from logical-axis rules, consumed by TrainStep, ``pipeline_apply``, the
+ZeRO engine and the serving AOT path.  A ``PartitionSpec(...)`` /
+``P(...)`` literal or ``NamedSharding(...)`` constructed anywhere else
+re-scatters that intent — exactly the hand-wiring the subsystem
+replaced across ~12 files.
+
+Rule: outside ``mxnet_tpu/parallel/`` (and the checker itself), code
+must not *construct* ``jax.sharding.PartitionSpec`` or
+``NamedSharding``.  Sharding intent flows through the planner
+(``plan.spec(name)`` / ``plan.partition_specs()`` /
+``plan.batch_spec()``) or the parallel-layer helpers.  Detected shapes:
+
+- a call to a name imported from ``jax.sharding`` (any alias —
+  ``from jax.sharding import PartitionSpec as P`` makes bare ``P(...)``
+  a construction site; an unrelated local variable named ``P`` stays
+  silent);
+- attribute calls ``jax.sharding.PartitionSpec(...)`` /
+  ``<alias>.NamedSharding(...)`` where the receiver resolves to the
+  ``jax.sharding`` module.
+
+Deliberate exceptions (tests exercising the parallel primitives
+directly, bench micro-harnesses) carry an inline
+``# mxtpu: noqa[MXT060] <reason>`` or a baseline entry.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Pass, register
+
+_ALLOWED_PREFIXES = ("mxnet_tpu/parallel/", "tools/")
+_TARGETS = {"PartitionSpec", "NamedSharding", "PositionalSharding",
+            "GSPMDSharding"}
+
+
+def _import_aliases(tree):
+    """Local name → jax.sharding symbol for every import form, plus the
+    set of local aliases that *are* the jax.sharding module itself."""
+    name_map = {}
+    module_aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.endswith("jax.sharding") or \
+                    node.module == "jax.sharding":
+                for a in node.names:
+                    if a.name in _TARGETS:
+                        name_map[a.asname or a.name] = a.name
+            if node.module == "jax":
+                # `from jax import sharding [as sh]` — the alias IS the
+                # module, so `sh.PartitionSpec(...)` must resolve
+                for a in node.names:
+                    if a.name == "sharding":
+                        module_aliases.add(a.asname or "sharding")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.sharding":
+                    module_aliases.add(a.asname or "jax.sharding")
+    return name_map, module_aliases
+
+
+@register
+class PlannerSharding(Pass):
+    name = "planner-sharding"
+    codes = {"MXT060": "raw sharding construction outside the planner "
+                       "(mxnet_tpu/parallel/)"}
+
+    def run(self, ctx, mod):
+        if mod.relpath.startswith(_ALLOWED_PREFIXES):
+            return []
+        name_map, module_aliases = _import_aliases(mod.tree)
+        findings = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = None
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in name_map:
+                what = name_map[f.id]
+            elif isinstance(f, ast.Attribute) and f.attr in _TARGETS:
+                # jax.sharding.PartitionSpec(...) / jsh.NamedSharding(...)
+                recv = f.value
+                dotted = None
+                if isinstance(recv, ast.Attribute) and \
+                        isinstance(recv.value, ast.Name):
+                    dotted = f"{recv.value.id}.{recv.attr}"
+                elif isinstance(recv, ast.Name):
+                    dotted = recv.id
+                if dotted == "jax.sharding" or dotted in module_aliases \
+                        or (dotted or "").endswith("sharding"):
+                    what = f.attr
+            if what is None:
+                continue
+            scope = mod.qualname(node)
+            findings.append(Finding(
+                code="MXT060", path=mod.relpath, line=node.lineno,
+                message=f"{what}(...) constructed outside "
+                        f"mxnet_tpu/parallel/ ({scope})",
+                hint="route sharding intent through the planner: build a "
+                     "ShardingPlan (parallel.planner.plan_sharding / "
+                     "plan_for) and consume plan.spec()/partition_specs()"
+                     "/batch_spec(), or add a parallel-layer helper; "
+                     "deliberate exceptions take "
+                     "`# mxtpu: noqa[MXT060] <reason>`",
+                scope=scope, key=f"raw-sharding:{what}",
+                col=node.col_offset))
+        return findings
