@@ -564,6 +564,129 @@ def _bench_zero_optimizer_bytes(dp):
             os.environ["MXNET_ZERO"] = prev
 
 
+def bench_graph():
+    """Graph compiler (ISSUE 11): pass-pipeline one-time cost, measured
+    fused-op count, and step-time A/B (pipeline on vs off) on (a) the
+    llama proxy through TrainStep and (b) a deep elementwise-chain
+    microbench — the workload whose dispatch graph the fusion pass
+    collapses hardest."""
+    import time
+
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu import graph as G
+    from mxnet_tpu.gluon import HybridBlock, nn
+    from mxnet_tpu.gluon.model_zoo.language import llama
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    out = {}
+
+    # -- (a) deep elementwise-chain microbench ----------------------------
+    class Chain(HybridBlock):
+        def __init__(self, depth=24, **kw):
+            super().__init__(**kw)
+            self.depth = depth
+            with self.name_scope():
+                self.fc = nn.Dense(128, in_units=64)
+
+        def hybrid_forward(self, F, x):
+            h = self.fc(x)
+            for _ in range(self.depth):
+                h = F.tanh(h * 0.5 + 0.125)
+            return h
+
+    def chain_arm(flag, prefix, iters=60):
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = Chain(prefix=prefix)
+        net.initialize()
+        net.hybridize()
+        x = nd.array(np.random.RandomState(1).randn(16, 64).astype("f"))
+        with G.override_enabled(flag):
+            t0 = time.perf_counter()
+            net(x).asnumpy()                      # build
+            build_s = time.perf_counter() - t0
+            for _ in range(5):
+                net(x).asnumpy()                  # warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                y = net(x)
+            y.asnumpy()
+            step_ms = (time.perf_counter() - t0) / iters * 1e3
+        fused = 0
+        for ir in getattr(net, "_cached_graph_ir", {}).values():
+            fused += ir.fused_op_count()
+        return {"build_s": round(build_s, 3),
+                "forward_ms": round(step_ms, 3), "fused_ops": fused}
+
+    G.reset_stats()
+    raw = chain_arm(False, "graw_")
+    opt = chain_arm(True, "gopt_")
+    stats = G.stats_snapshot()
+    pipeline_s = sum(p["seconds"] for p in stats["passes"].values())
+    out["elemwise_chain"] = {
+        "optimized": opt, "raw": raw,
+        "pipeline_one_time_s": round(pipeline_s, 4),
+        "speedup": round(raw["forward_ms"] / opt["forward_ms"], 3)
+        if opt["forward_ms"] else 0.0,
+    }
+
+    # -- (b) llama proxy through TrainStep (the functionalize seam) -------
+    cfg = dict(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+               num_kv_heads=2, intermediate_size=256, max_seq_len=64)
+    ids = np.random.RandomState(0).randint(
+        0, cfg["vocab_size"], (2, 64)).astype("int32")
+    labels = np.random.RandomState(1).randint(
+        0, cfg["vocab_size"], (2, 64)).astype("int32")
+
+    def loss_fn(logits, y):
+        import jax.numpy as jnp
+
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, y[..., None], axis=-1)
+
+    def llama_arm(flag, iters=12):
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = llama.LlamaForCausalLM(llama.LlamaConfig(**cfg))
+        net.initialize()
+        net(mx.nd.zeros((1, 64), dtype="int32"))
+        step = TrainStep(net, loss_fn, optimizer="adam",
+                         optimizer_params={"learning_rate": 3e-4})
+        G.reset_stats()
+        with G.override_enabled(flag):
+            t0 = time.perf_counter()
+            step(ids, labels)                     # build
+            build_s = time.perf_counter() - t0
+            for _ in range(3):
+                float(step(ids, labels))          # warm (sync each)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = step(ids, labels)
+            float(loss)
+            step_ms = (time.perf_counter() - t0) / iters * 1e3
+        snap = G.stats_snapshot()
+        return {"build_s": round(build_s, 2),
+                "step_ms": round(step_ms, 2),
+                "fused_ops": snap["fused_ops_created"],
+                "pipeline_one_time_s": round(
+                    sum(p["seconds"] for p in snap["passes"].values()), 4),
+                "fallbacks": snap["fallbacks"]}
+
+    l_raw = llama_arm(False)
+    l_opt = llama_arm(True)
+    out["llama_proxy"] = {
+        "optimized": l_opt, "raw": l_raw,
+        "speedup": round(l_raw["step_ms"] / l_opt["step_ms"], 3)
+        if l_opt["step_ms"] else 0.0,
+    }
+    out["fused_op_count"] = opt["fused_ops"] + l_opt["fused_ops"]
+    return out
+
+
 def bench_planner():
     """Sharding planner (ISSUE 10): plan-time overhead (one-time, host
     only), the zero-per-step-cost contract (compile-tracer-asserted:
@@ -909,6 +1032,13 @@ def main():
         extra["planner"] = bench_planner()
     except Exception as e:
         extra["planner"] = {"error": repr(e)[:200]}
+    try:
+        # graph compiler (ISSUE 11): pass-pipeline one-time cost,
+        # measured fused-op count, and optimized-vs-raw step time on
+        # the llama proxy + a deep elementwise-chain microbench
+        extra["graph"] = bench_graph()
+    except Exception as e:
+        extra["graph"] = {"error": repr(e)[:200]}
     try:
         # BASELINE binding metric: allreduce bandwidth (tools/bandwidth_
         # measure.py ≙ reference tools/bandwidth/measure.py).  The bus

@@ -1,0 +1,183 @@
+"""Graph-compiler-lane smoke (ISSUE 11): the pass pipeline through the
+PUBLIC surface on the CPU mesh.
+
+What must hold before this lane goes green:
+
+1. **Fused-op count** — a deep elementwise-chain block optimizes to a
+   graph with fused chain nodes (measured, > 0) and fewer nodes.
+2. **Parity** — hybridized forward AND a 5-step Trainer trajectory are
+   bit-identical with the pipeline on vs off (fp32 contract).
+3. **Idempotence across processes** — the optimized graph's structure
+   digest is identical when the same seeded model is optimized in two
+   fresh subprocesses (no process-local state leaks into the result).
+4. **Raw-vs-optimized trace counts** — with the pipeline on, steady
+   state performs zero fresh traces after the first build (same count
+   contract as the raw path), and the one-time pipeline cost + step
+   timings are printed for the record.
+
+Run by ci/runtest.sh graph as:  JAX_PLATFORMS=cpu python ci/graph_smoke.py
+"""
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd, telemetry  # noqa: E402
+from mxnet_tpu import graph as G  # noqa: E402
+from mxnet_tpu.gluon import HybridBlock, Trainer, nn  # noqa: E402
+
+
+class DeepChain(HybridBlock):
+    """Dense layers joined by deep elementwise chains — the fusion
+    pass's bread and butter."""
+
+    def __init__(self, depth=8, **kw):
+        super().__init__(**kw)
+        self.depth = depth
+        with self.name_scope():
+            self.fc1 = nn.Dense(32, in_units=16)
+            self.fc2 = nn.Dense(8, in_units=32)
+
+    def hybrid_forward(self, F, x):
+        h = self.fc1(x)
+        for _ in range(self.depth):
+            h = F.tanh(h * 0.5 + 0.125)
+        return self.fc2(h)
+
+
+_SUBPROC_SNIPPET = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {root!r})
+import numpy as np, jax
+import mxnet_tpu as mx
+from mxnet_tpu import graph as G
+from ci.graph_smoke import DeepChain
+
+mx.random.seed(0); np.random.seed(0)
+net = DeepChain(prefix="smoke_")
+net.initialize()
+plist = sorted(net.collect_params().items())
+g = G.trace_block(net, plist, [jax.ShapeDtypeStruct((4, 16), np.float32)])
+opt = G.default_pipeline().run(g)
+print("DIGEST", opt.signature(), len(opt.nodes), opt.fused_op_count())
+"""
+
+
+def check(ok, what):
+    if not ok:
+        print(f"graph_smoke: FAIL - {what}")
+        sys.exit(1)
+    print(f"graph_smoke: ok - {what}")
+
+
+def main():
+    # 1) fused-op count + node shrink, in process
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = DeepChain(prefix="smoke_")
+    net.initialize()
+    plist = sorted(net.collect_params().items())
+    g = G.trace_block(net, plist,
+                      [jax.ShapeDtypeStruct((4, 16), np.float32)])
+    t0 = time.perf_counter()
+    opt = G.default_pipeline().run(g)
+    pipeline_s = time.perf_counter() - t0
+    check(opt.fused_op_count() >= 1, f"fused-op count "
+          f"{opt.fused_op_count()} > 0 (nodes {len(g.nodes)} -> "
+          f"{len(opt.nodes)}, one-time cost {pipeline_s * 1e3:.1f} ms)")
+    check(len(opt.nodes) < len(g.nodes), "pipeline shrinks the graph")
+
+    # idempotence in process: optimizing the optimized graph is a no-op
+    opt2 = G.default_pipeline().run(opt)
+    check(opt.signature() == opt2.signature(),
+          "pipeline is idempotent (fixed point)")
+
+    # 2) cross-process digest determinism
+    digests = []
+    for _ in range(2):
+        out = subprocess.run(
+            [sys.executable, "-c",
+             _SUBPROC_SNIPPET.format(root=os.path.dirname(
+                 os.path.dirname(os.path.abspath(__file__))))],
+            capture_output=True, text=True, timeout=300)
+        check(out.returncode == 0,
+              f"subprocess optimize (rc={out.returncode}; "
+              f"{(out.stderr or '')[-300:]})")
+        digests.append([ln for ln in out.stdout.splitlines()
+                        if ln.startswith("DIGEST")][0])
+    check(digests[0] == digests[1],
+          f"optimized-graph digest identical across processes "
+          f"({digests[0].split()[1][:12]}...)")
+
+    # 3) parity on the CPU mesh: forward + 5-step trajectory, on vs off
+    def trajectory(flag, prefix):
+        mx.random.seed(7)
+        np.random.seed(7)
+        net = DeepChain(prefix=prefix)
+        net.initialize()
+        net.hybridize()
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.05})
+        rs = np.random.RandomState(3)
+        losses = []
+        with G.override_enabled(flag):
+            t0 = time.perf_counter()
+            for _ in range(5):
+                x = nd.array(rs.randn(4, 16).astype("f"))
+                with autograd.record():
+                    y = net(x)
+                    loss = (y * y).mean()
+                loss.backward()
+                trainer.step(4)
+                losses.append(float(loss.asnumpy()))
+            wall = time.perf_counter() - t0
+        params = {k[len(prefix):]: p.data().asnumpy()
+                  for k, p in net.collect_params().items()}
+        return losses, params, wall
+
+    on_l, on_p, on_wall = trajectory(True, "on_")
+    off_l, off_p, off_wall = trajectory(False, "off_")
+    check(on_l == off_l, f"5-step losses bit-identical on vs off ({on_l[0]:.6f} -> {on_l[-1]:.6f})")
+    check(all(np.array_equal(on_p[k], off_p[k]) for k in on_p),
+          "parameters bit-identical after 5 steps")
+    print(f"graph_smoke: step wall optimized {on_wall:.3f}s vs raw "
+          f"{off_wall:.3f}s (includes one-time build)")
+
+    # 4) trace counts: steady state performs zero fresh traces
+    mx.random.seed(1)
+    net = DeepChain(prefix="steady_")
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.RandomState(5).randn(4, 16).astype("f"))
+    with G.override_enabled(True):
+        net(x)                      # build (traces + pipeline run here)
+        before = telemetry.snapshot()["compile"]["count"]
+        for _ in range(10):
+            net(x)
+        after = telemetry.snapshot()["compile"]["count"]
+    check(after == before,
+          "zero fresh traces over 10 optimized steady-state forwards")
+
+    snap = telemetry.snapshot()["graph"]
+    check(snap["pipeline_runs"] >= 3 and snap["fused_ops_created"] >= 1,
+          f"snapshot graph section: {snap['pipeline_runs']} runs, "
+          f"{snap['fused_ops_created']} fused ops, "
+          f"{snap['fallbacks']} fallbacks")
+    print("graph_smoke: PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -70,6 +70,7 @@ from .util import test_utils
 from . import runtime
 from . import callback
 from . import monitor
+from . import graph
 from . import subgraph
 from . import numpy as np  # mx.np — NumPy-compatible namespace
 from . import numpy_extension as npx

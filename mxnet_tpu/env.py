@@ -125,8 +125,18 @@ Wired vars (read at ``import mxnet_tpu``):
   jax upgrade" item is now this one flag).
 - ``MXNET_PLANNER_REPORT``: print the planner's ``visualize_sharding``
   report whenever a plan is computed (default 0).
+- ``MXNET_GRAPH_PIPELINE``: graph-compiler pass pipeline between the
+  traced (hybridized) graph and jit lowering (default 1; see
+  :mod:`mxnet_tpu.graph` and README "Graph compiler").  0 = every
+  consumer runs the raw traced program.
+- ``MXNET_GRAPH_PASSES``: comma-separated graph-pass selection; plain
+  names replace the default list, ``-name`` entries subtract from it
+  (unset = the default catalog).
+- ``MXNET_GRAPH_FUSE_CAP``: max ops per fused elementwise chain in the
+  ``fuse_elemwise_chains`` pass (default 16; < 2 disables fusion).
 - ``MXNET_SUBGRAPH_BACKEND``: subgraph backend applied automatically at
-  Module bind time (see :mod:`mxnet_tpu.subgraph`; unset = none).
+  Module bind time (see :mod:`mxnet_tpu.subgraph`; the backends are
+  sugar over the graph-compiler pipeline; unset = none).
 - ``MXNET_NUM_WORKERS``: launcher-provided world size for
   ``parallel.distributed.init`` (``DMLC_NUM_WORKER`` is the legacy
   alias; default 1 = single process).
@@ -344,6 +354,24 @@ def planner_report():
     return get_bool("MXNET_PLANNER_REPORT", False)
 
 
+def graph_pipeline():
+    """Graph-compiler pass pipeline on the hybridize/TrainStep/serving
+    trace seam (MXNET_GRAPH_PIPELINE, default on; mxnet_tpu/graph)."""
+    return get_bool("MXNET_GRAPH_PIPELINE", True)
+
+
+def graph_passes():
+    """Graph-pass selection spec (MXNET_GRAPH_PASSES; unset = default
+    catalog, "-name" subtracts — parsed by graph.selected_pass_names)."""
+    return get_str("MXNET_GRAPH_PASSES", "")
+
+
+def graph_fuse_cap():
+    """Max ops per fused elementwise chain (MXNET_GRAPH_FUSE_CAP,
+    default 16; < 2 disables the fusion pass)."""
+    return get_int("MXNET_GRAPH_FUSE_CAP", 16)
+
+
 def describe():
     """One line per known var: current value and what it maps to."""
     lines = []
@@ -428,6 +456,13 @@ def describe():
          "workaround (default 0; flip after a jax upgrade)"),
         ("MXNET_PLANNER_REPORT", "print the visualize_sharding report "
          "at plan time (default 0)"),
+        ("MXNET_GRAPH_PIPELINE", "graph-compiler pass pipeline on the "
+         "hybridize/TrainStep/serving trace seam (default 1; "
+         "mxnet_tpu/graph)"),
+        ("MXNET_GRAPH_PASSES", "graph-pass selection (csv; \"-name\" "
+         "subtracts from the default catalog; unset = defaults)"),
+        ("MXNET_GRAPH_FUSE_CAP", "max ops per fused elementwise chain "
+         "(default 16; < 2 disables fusion)"),
         ("MXNET_SUBGRAPH_BACKEND", "subgraph backend applied at Module "
          "bind time (mxnet_tpu.subgraph; unset = none)"),
         ("MXNET_NUM_WORKERS", "launcher world size for distributed.init "

@@ -1,0 +1,85 @@
+"""Registered fused ops for the elementwise-chain fusion pass.
+
+Each structurally distinct chain registers ONE op (``_gfused_chainN``)
+that replays the captured registry kernels in order — pure, traceable,
+differentiable, and AMP-faithful: the replay applies the same per-op
+cast wrap ``ndarray.invoke`` would, so a fused chain is numerically the
+unfused chain, just one dispatch and one graph node.  Structurally
+identical chains share one registration (repeated pipeline runs must
+not grow OP_TABLE — same contract as ``subgraph._make_region_op``).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+from ..ops.registry import OP_TABLE, register
+
+__all__ = ["register_fused_chain", "fused_plan_summary"]
+
+_LOCK = threading.Lock()
+_CACHE = {}          # structural signature -> registered op name
+_COUNTER = [0]
+
+
+def fused_plan_summary(plan):
+    """Human-readable chain summary for the node attrs / telemetry."""
+    return "+".join(op for op, _, _ in plan)
+
+
+def plan_digest(plan):
+    """Structural digest of a fused plan (ops + attrs + wiring) —
+    process-independent, unlike the counter-assigned op name.  Stamped
+    on fused nodes (``__fused_sig__``) so ``Graph.signature()`` hashes
+    the chain's STRUCTURE, keeping digests stable across processes
+    with different fusion histories."""
+    import hashlib
+
+    sig = tuple(
+        (op, tuple(sorted((k, repr(v)) for k, v in attrs.items())),
+         tuple(srcs))
+        for op, attrs, srcs in plan)
+    return hashlib.sha256(repr(sig).encode()).hexdigest()
+
+
+def register_fused_chain(plan):
+    """Register (or reuse) the op executing ``plan``.
+
+    ``plan``: ordered ``(op_name, attrs_dict, srcs)`` steps where each
+    src is ``("ext", k)`` — the fused node's k-th input — or
+    ``("step", j)`` — step j's output.  The last step's output is the
+    fused op's single output.
+    """
+    sig = tuple(
+        (op, tuple(sorted((k, repr(v)) for k, v in attrs.items())),
+         tuple(srcs))
+        for op, attrs, srcs in plan)
+    with _LOCK:
+        cached = _CACHE.get(sig)
+        if cached is not None:
+            return cached
+        _COUNTER[0] += 1
+        opname = f"_gfused_chain{_COUNTER[0]}"
+    ods = [OP_TABLE[op] for op, _, _ in plan]
+    steps = [(od, dict(attrs), tuple(srcs))
+             for od, (_, attrs, srcs) in zip(ods, plan)]
+
+    def fused_fn(*ext_vals):
+        from ..ndarray.ndarray import _AMP, _call_with_attrs
+
+        wrap = _AMP["wrap"] if _AMP["on"] else None
+        vals = []
+        for od, attrs, srcs in steps:
+            f = functools.partial(_call_with_attrs, od.fn, attrs)
+            if wrap is not None:
+                f = wrap(od, f)
+            vals.append(f(*(ext_vals[k] if kind == "ext" else vals[k]
+                            for kind, k in srcs)))
+        return vals[-1]
+
+    fused_fn.__name__ = opname
+    fused_fn.__doc__ = f"fused elementwise chain: {fused_plan_summary(plan)}"
+    register(opname)(fused_fn)
+    with _LOCK:
+        _CACHE[sig] = opname
+    return opname

@@ -1,0 +1,423 @@
+"""The built-in optimization passes (pure ``Graph -> Graph``).
+
+Every pass carries a bit-parity contract: on fp32 paths the optimized
+graph's outputs BIT-MATCH the unoptimized graph's (the pipeline A/B
+tests pin this).  The mechanisms used are exact by construction —
+constant folding evaluates the same registry kernels under the same
+AMP wrap the executor would; CSE merges structurally identical
+deterministic nodes; DCE only removes unreachable work; chain fusion
+replays the captured kernels in order inside one registered op; and
+the AMP-cast pass applies only bit-exact moves (identity-cast removal,
+widen-then-narrow collapse, commuting casts with data-movement ops).
+RNG-consuming ops are excluded from folding/CSE/fusion and keep their
+trace-stamped fold_in counters, so key streams never shift.
+
+Purity (MXT070): a pass must never mutate the input graph's nodes or
+attrs — each starts from ``graph.copy()`` and only mutates the copy.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import env as _env
+from ..ops.registry import OP_TABLE
+from .fusion import fused_plan_summary, plan_digest, register_fused_chain
+from .ir import Graph, Node
+from .pipeline import graph_pass
+
+__all__ = ["fold_constants", "eliminate_common_subexpr",
+           "place_amp_casts", "fuse_elemwise_chains",
+           "eliminate_dead_nodes", "ELEMWISE_OPS"]
+
+# ops a chain-fusion region may absorb: one output, elementwise, no RNG,
+# no training-mode state injection (same exclusions as subgraph islands)
+ELEMWISE_OPS = frozenset({
+    "Activation", "activation", "relu", "sigmoid", "tanh", "softsign",
+    "gelu", "silu", "softrelu", "exp", "log", "sqrt", "rsqrt", "square",
+    "abs", "sign", "negative", "clip", "swiglu",
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_maximum", "broadcast_minimum", "broadcast_power",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "broadcast_add_scalar", "broadcast_sub_scalar", "broadcast_mul_scalar",
+    "broadcast_div_scalar", "broadcast_maximum_scalar",
+    "broadcast_minimum_scalar", "broadcast_power_scalar",
+    "Cast", "cast", "amp_cast",
+})
+
+# never folded/CSE'd: executor injects per-call behavior (training-mode
+# state threading) keyed on these names
+_STATE_SENSITIVE = frozenset({"BatchNorm", "Dropout", "RNN"})
+
+_FOLD_MAX_ELEMENTS = 1 << 20
+
+
+def _literal(v):
+    if isinstance(v, (type(None), bool, int, float, str, slice)):
+        return True
+    if isinstance(v, (tuple, list)):
+        return all(_literal(x) for x in v)
+    return False
+
+
+def _clean(attrs):
+    return {k: v for k, v in attrs.items() if not k.startswith("__")}
+
+
+def _apply_edge_remap(g, remap):
+    """Rewrite every edge of ``g`` (a fresh copy) through ``remap``,
+    following chains so a->b->c resolves to c."""
+    if not remap:
+        return
+
+    def res(e):
+        seen = set()
+        while e in remap and e not in seen:
+            seen.add(e)
+            e = remap[e]
+        return e
+
+    for n in g.nodes:
+        n.inputs = [res(e) for e in n.inputs]
+    g.outputs = [res(e) for e in g.outputs]
+    g.state = [(k, res(e)) for k, e in g.state]
+
+
+def _dtype_of(g, edge):
+    nid, idx = edge
+    avals = g.nodes[nid].avals
+    if avals is None or idx >= len(avals):
+        return None
+    return _np.dtype(avals[idx][1])
+
+
+# --------------------------------------------------------------------------
+@graph_pass("fold_constants")
+def fold_constants(graph):
+    """Evaluate op nodes whose inputs are all constants and embed the
+    result (the executor would recompute them every call; XLA usually
+    folds too, but folding here shrinks the traced program and lets CSE
+    and fusion see through the values).  Evaluation runs the same
+    registry kernel under the same AMP wrap the executor applies, so
+    the embedded value is the value the unfolded graph would produce."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from ..ndarray.ndarray import _AMP, _call_with_attrs
+
+    g = graph.copy()
+    counts = g.consumer_counts()
+    const_vals = {}
+    for nid, n in enumerate(g.nodes):
+        if n.is_const:
+            const_vals[(nid, 0)] = n.value
+    folded = {}
+    wrap = _AMP["wrap"] if _AMP["on"] else None
+    for nid, n in enumerate(g.nodes):
+        if n.op is None or not counts.get(nid):
+            continue
+        od = OP_TABLE.get(n.op)
+        if od is None or od.needs_rng or not od.jit_safe or \
+                n.op in _STATE_SENSITIVE:
+            continue
+        if not all(e in const_vals for e in n.inputs):
+            continue
+        f = functools.partial(_call_with_attrs, od.fn, _clean(n.attrs))
+        if wrap is not None:
+            f = wrap(od, f)
+        try:
+            out = f(*(jnp.asarray(const_vals[e]) for e in n.inputs))
+        except Exception:
+            continue
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        if any(getattr(o, "size", _FOLD_MAX_ELEMENTS + 1) >
+               _FOLD_MAX_ELEMENTS for o in outs):
+            continue
+        vals = [_np.asarray(o) for o in outs]
+        folded[nid] = vals
+        for i, v in enumerate(vals):
+            const_vals[(nid, i)] = v
+    if not folded:
+        return g
+
+    used = set()
+    for n in g.nodes:
+        used.update(n.inputs)
+    used.update(g.outputs)
+    used.update(e for _, e in g.state)
+
+    new_nodes, id_map, remap = [], {}, {}
+    for nid, n in enumerate(g.nodes):
+        if nid in folded:
+            for i, v in enumerate(folded[nid]):
+                if (nid, i) in used:
+                    remap[(nid, i)] = (len(new_nodes), 0)
+                    new_nodes.append(Node(
+                        None, f"{n.name}_fold{i}", {}, [], 1, v,
+                        avals=((tuple(v.shape), str(v.dtype)),)))
+        else:
+            id_map[nid] = len(new_nodes)
+            new_nodes.append(n)
+    def res(e):
+        return remap[e] if e in remap else (id_map[e[0]], e[1])
+
+    for n in new_nodes:
+        n.inputs = [res(e) for e in n.inputs]
+    out = Graph(
+        new_nodes, [id_map[i] for i in g.inputs],
+        [(id_map[i], nm) for i, nm in g.params],
+        [res(e) for e in g.outputs],
+        [(k, res(e)) for k, e in g.state],
+        g.single)
+    return out.validate()
+
+
+# --------------------------------------------------------------------------
+@graph_pass("eliminate_common_subexpr")
+def eliminate_common_subexpr(graph):
+    """Merge structurally identical deterministic nodes (same op, attrs,
+    inputs): later duplicates re-route to the earliest occurrence.
+    RNG ops never merge (two dropouts are two draws), state-injecting
+    ops (BatchNorm/Dropout/RNN) never merge (their write-back heads
+    must stay distinct); constants merge by value."""
+    g = graph.copy()
+    canon = {}
+    remap = {}
+    for nid, n in enumerate(g.nodes):
+        n.inputs = [remap.get(e, e) for e in n.inputs]
+        if n.is_var:
+            continue
+        if n.is_const:
+            v = _np.asarray(n.value)
+            key = ("__const__", str(v.dtype), v.shape, v.tobytes())
+            first = canon.get(key)
+            if first is None:
+                canon[key] = nid
+            else:
+                remap[(nid, 0)] = (first, 0)
+            continue
+        od = OP_TABLE.get(n.op)
+        if od is None or od.needs_rng or n.op in _STATE_SENSITIVE:
+            continue
+        if not all(_literal(v) for v in n.attrs.values()):
+            continue
+        key = (n.op, tuple(sorted((k, repr(v)) for k, v in n.attrs.items())),
+               tuple(n.inputs), n.nout)
+        first = canon.get(key)
+        if first is None:
+            canon[key] = nid
+        else:
+            for i in range(n.nout):
+                remap[(nid, i)] = (first, i)
+    _apply_edge_remap(g, remap)
+    return g.validate()
+
+
+# --------------------------------------------------------------------------
+_CAST_OPS = frozenset({"Cast", "cast", "amp_cast"})
+_MOVEMENT_OPS = frozenset({"reshape", "Reshape", "transpose", "expand_dims",
+                           "squeeze", "flatten", "Flatten"})
+_EXACT_WIDENINGS = {
+    _np.dtype("float16"): (_np.dtype("float32"), _np.dtype("float64")),
+    _np.dtype("float32"): (_np.dtype("float64"),),
+}
+
+
+def _bf16():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16
+
+
+def _is_exact_widening(narrow, wide):
+    try:
+        narrow = _np.dtype(narrow)
+        wide = _np.dtype(wide)
+    except TypeError:
+        return False
+    if narrow == _np.dtype(_bf16()):
+        return wide in (_np.dtype("float32"), _np.dtype("float64"))
+    return wide in _EXACT_WIDENINGS.get(narrow, ())
+
+
+@graph_pass("place_amp_casts")
+def place_amp_casts(graph):
+    """Bit-exact cast placement: drop identity casts, collapse
+    widen-then-narrow round trips back to the source, and hoist casts
+    above single-consumer data-movement ops (reshape/transpose/...)
+    so redundant casts on hot chains meet — and CSE merges them.
+    Moves that would change numerics are never made."""
+    g = graph.copy()
+    for _ in range(8):
+        counts = g.consumer_counts()
+        remap = {}
+        changed = False
+        for nid, n in enumerate(g.nodes):
+            if n.op not in _CAST_OPS or not n.inputs:
+                continue
+            in_edge = n.inputs[0]
+            src_dt = _dtype_of(g, in_edge)
+            try:
+                tgt_dt = _np.dtype(n.attrs.get("dtype"))
+            except TypeError:
+                continue
+            if src_dt is not None and src_dt == tgt_dt:
+                remap[(nid, 0)] = in_edge          # identity cast
+                changed = True
+                continue
+            pid, pidx = in_edge
+            producer = g.nodes[pid]
+            if producer.op in _CAST_OPS and producer.inputs:
+                base_edge = producer.inputs[0]
+                base_dt = _dtype_of(g, base_edge)
+                wide_dt = _dtype_of(g, in_edge)
+                if base_dt is not None and wide_dt is not None and \
+                        base_dt == tgt_dt and \
+                        _is_exact_widening(base_dt, wide_dt):
+                    remap[(nid, 0)] = base_edge    # narrow(wide(x)) == x
+                    changed = True
+                    continue
+            if producer.op in _MOVEMENT_OPS and producer.nout == 1 and \
+                    counts.get(pid) == 1 and producer.inputs and \
+                    src_dt is not None:
+                # swap in place: cast(move(x)) -> move(cast(x)) — a pure
+                # element permutation commutes with the cast bit-exactly
+                base_edge = producer.inputs[0]
+                base_shape = None
+                if g.nodes[base_edge[0]].avals is not None and \
+                        base_edge[1] < len(g.nodes[base_edge[0]].avals):
+                    base_shape = g.nodes[base_edge[0]].avals[base_edge[1]][0]
+                move_shape = producer.avals[0][0] \
+                    if producer.avals else None
+                new_cast = Node(n.op, f"{n.name}_hoist", dict(n.attrs),
+                                [base_edge], 1, None,
+                                avals=None if base_shape is None else
+                                ((base_shape, str(tgt_dt)),))
+                new_move = Node(producer.op, producer.name,
+                                dict(producer.attrs), [(pid, 0)], 1, None,
+                                avals=None if move_shape is None else
+                                ((move_shape, str(tgt_dt)),))
+                g.nodes[pid] = new_cast
+                g.nodes[nid] = new_move
+                changed = True
+        _apply_edge_remap(g, remap)
+        if not changed:
+            break
+    return g.validate()
+
+
+# --------------------------------------------------------------------------
+@graph_pass("fuse_elemwise_chains")
+def fuse_elemwise_chains(graph):
+    """Collapse linear single-consumer chains of elementwise ops into one
+    registered fused op each (``MXNET_GRAPH_FUSE_CAP`` bounds chain
+    length).  The fused op replays the captured kernels in order under
+    the executor's own AMP wrap — one node, one dispatch, identical
+    numerics."""
+    cap = _env.graph_fuse_cap()
+    if cap < 2:
+        return graph.copy()
+    g = graph.copy()
+    counts = g.consumer_counts()
+    head_ids = {nid for nid, _ in g.outputs} | \
+               {nid for _, (nid, _) in g.state}
+
+    def eligible(nid):
+        n = g.nodes[nid]
+        od = OP_TABLE.get(n.op)
+        return n.op in ELEMWISE_OPS and n.nout == 1 and \
+            od is not None and not od.needs_rng
+
+    consumers = {}
+    for cid, n in enumerate(g.nodes):
+        for pid, idx in n.inputs:
+            if idx == 0:
+                consumers.setdefault(pid, []).append(cid)
+    next_of, has_prev = {}, set()
+    for nid in range(len(g.nodes)):
+        if not eligible(nid) or counts.get(nid) != 1 or nid in head_ids:
+            continue
+        # counts == 1 means the single consuming edge appears exactly once
+        cons = consumers.get(nid)
+        if cons and eligible(cons[0]):
+            next_of[nid] = cons[0]
+            has_prev.add(cons[0])
+
+    chains = []
+    for nid in range(len(g.nodes)):
+        if not eligible(nid) or nid in has_prev:
+            continue
+        full = [nid]
+        while full[-1] in next_of:
+            full.append(next_of[full[-1]])
+        # the cap splits long chains into bounded segments, each fused
+        for i in range(0, len(full), cap):
+            seg = full[i:i + cap]
+            if len(seg) >= 2:
+                chains.append(seg)
+
+    if not chains:
+        return g
+    member_of = {}
+    for ci, chain in enumerate(chains):
+        for nid in chain:
+            member_of[nid] = ci
+
+    new_nodes, id_map = [], {}
+    fused_at = {chain[-1]: chain for chain in chains}
+    for nid, n in enumerate(g.nodes):
+        if nid in member_of and nid not in fused_at:
+            continue                      # interior chain member
+        if nid in fused_at:
+            chain = fused_at[nid]
+            chain_ids = set(chain)
+            pos = {m: i for i, m in enumerate(chain)}
+            ext, ext_index = [], {}
+            plan = []
+            for m in chain:
+                srcs = []
+                for e in g.nodes[m].inputs:
+                    if e[0] in chain_ids:
+                        srcs.append(("step", pos[e[0]]))
+                    else:
+                        if e not in ext_index:
+                            ext_index[e] = len(ext)
+                            ext.append(e)
+                        srcs.append(("ext", ext_index[e]))
+                plan.append((g.nodes[m].op, _clean(g.nodes[m].attrs),
+                             tuple(srcs)))
+            opname = register_fused_chain(plan)
+            tail = g.nodes[chain[-1]]
+            fused = Node(opname, f"{g.nodes[chain[0]].name}_gfused",
+                         {"__fused_plan__": fused_plan_summary(plan),
+                          "__fused_sig__": plan_digest(plan),
+                          "__n_fused__": len(chain)},
+                         list(ext), 1, None, avals=tail.avals)
+            id_map[nid] = len(new_nodes)
+            new_nodes.append(fused)
+        else:
+            id_map[nid] = len(new_nodes)
+            new_nodes.append(n)
+    # resolve edges: chain tails -> fused node, everything else -> id_map
+    def res(e):
+        nid, idx = e
+        if nid in fused_at:
+            return (id_map[nid], 0)
+        return (id_map[nid], idx)
+
+    for n in new_nodes:
+        n.inputs = [res(e) for e in n.inputs]
+    out = Graph(new_nodes, [id_map[i] for i in g.inputs],
+                [(id_map[i], nm) for i, nm in g.params],
+                [res(e) for e in g.outputs],
+                [(k, res(e)) for k, e in g.state], g.single)
+    return out.validate()
+
+
+# --------------------------------------------------------------------------
+@graph_pass("eliminate_dead_nodes")
+def eliminate_dead_nodes(graph):
+    """Drop nodes unreachable from the output/state heads.  Declared
+    input and parameter variables always survive — the executor binds
+    them positionally, so the call signature is stable."""
+    return graph.compact(graph.live_ids()).validate()

@@ -1,0 +1,152 @@
+"""Trace a HybridBlock forward into the graph IR.
+
+The trace reuses the export-path machinery (SymbolTracer proxies through
+``ndarray.invoke``) but, unlike ``_trace_to_symbol``, it is execution-
+faithful: it runs under the CURRENT training mode, records node CREATION
+order (via ``symbol._TRACE_OBSERVER``) so the executor replays ops in
+the exact sequence the imperative jit trace would, stamps every
+needs_rng op with its fold_in counter at trace time, and captures the
+running-state write-backs (BatchNorm moving stats) as extra graph
+heads.  Anything the proxies cannot express (``apply_fn`` composites,
+host reads in forward) raises — callers fall back to the imperative jit
+path and record a ``graph_fallback`` compile event.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ir import Graph, Node
+
+__all__ = ["trace_block"]
+
+
+def _aval_sig(aval):
+    return (tuple(aval.shape), str(_np.dtype(aval.dtype)))
+
+
+def trace_block(block, param_items, input_avals, train_mode=False):
+    """Trace ``block.forward`` once into a :class:`Graph`.
+
+    ``param_items``: ordered ``(name, Parameter)`` pairs — positional
+    binding order of the executor's ``param_vals``.  ``input_avals``:
+    ``jax.ShapeDtypeStruct`` per data input.  Returns a validated Graph
+    whose ``state`` entries name parameters from ``param_items``.
+    """
+    import jax
+
+    from .. import autograd as _ag
+    from ..gluon.block import _TRACE, _TraceContext
+    from ..ndarray import ndarray as _ndmod
+    from ..ops.registry import get_op
+    from ..symbol.symbol import SymbolTracer, _Node, _TRACE_OBSERVER
+
+    nodes, inputs, params = [], [], []
+    sid = {}                 # id(_Node) -> graph node id
+
+    def add(sn, rng_index=None, avals=None):
+        nid = len(nodes)
+        sid[id(sn)] = nid
+        nodes.append(Node(sn.op, sn.name, dict(sn.attrs),
+                          [], sn.nout, sn.value,
+                          rng_index=rng_index, avals=avals))
+        return nid
+
+    param_map, name_of = {}, {}
+    for name, p in param_items:
+        d = p.data()
+        aval = jax.ShapeDtypeStruct(tuple(d.shape), _np.dtype(d.dtype))
+        sn = _Node(None, name, {})
+        params.append((add(sn, avals=(_aval_sig(aval),)), name))
+        param_map[p] = SymbolTracer((sn, 0), aval)
+        name_of[id(p)] = name
+    in_tracers = []
+    for i, aval in enumerate(input_avals):
+        name = "data" if len(input_avals) == 1 else f"data{i}"
+        sn = _Node(None, name, {})
+        inputs.append(add(sn, avals=(_aval_sig(aval),)))
+        in_tracers.append(SymbolTracer((sn, 0), aval))
+
+    recorded = []            # (sym node, avals) in creation order
+    rng_counter = [0]
+    rng_of = {}
+
+    def observe(sn, out_avals):
+        if get_op(sn.op).needs_rng:
+            # the imperative trace key is fold_in(base, counter) with the
+            # counter bumped once per needs_rng invoke — same numbering
+            rng_counter[0] += 1
+            rng_of[id(sn)] = rng_counter[0]
+        recorded.append((sn, tuple(_aval_sig(a) for a in out_avals)))
+
+    tc = _TraceContext(param_map)
+    prev_ctx = _TRACE.ctx
+    prev_obs = _TRACE_OBSERVER[0]
+    if prev_obs is not None:
+        raise MXNetError("graph trace is not reentrant")
+    _TRACE.ctx = tc
+    _TRACE_OBSERVER[0] = observe
+    prev_train = _ag.set_training(train_mode)
+    prev_rec = _ag.set_recording(False)
+    _ndmod._SYMTRACE["on"] = True
+    _ndmod._SYMTRACE["rng_ops"] = True
+    try:
+        out = block.forward(*in_tracers)
+    finally:
+        _ndmod._SYMTRACE["rng_ops"] = False
+        _ndmod._SYMTRACE["on"] = False
+        _ag.set_recording(prev_rec)
+        _ag.set_training(prev_train)
+        _TRACE_OBSERVER[0] = prev_obs
+        _TRACE.ctx = prev_ctx
+
+    # materialize ops in creation order, pulling each op's still-unseen
+    # inputs (constants lifted by trace_invoke) in just before it
+    for sn, out_avals in recorded:
+        for inp, _ in sn.inputs:
+            if id(inp) not in sid:
+                if inp.op is not None:
+                    raise MXNetError(
+                        f"graph trace: op node {inp.name} was consumed but "
+                        "never observed")
+                if inp.is_var:
+                    raise MXNetError(
+                        f"graph trace: unbound variable {inp.name!r} "
+                        "(neither a parameter nor a data input)")
+                add(inp, avals=((tuple(inp.value.shape),
+                                 str(inp.value.dtype)),))
+        nid = len(nodes)
+        sid[id(sn)] = nid
+        nodes.append(Node(sn.op, sn.name, dict(sn.attrs),
+                          [(sid[id(i)], idx) for i, idx in sn.inputs],
+                          sn.nout, sn.value,
+                          rng_index=rng_of.get(id(sn)), avals=out_avals))
+
+    single = not isinstance(out, (list, tuple))
+    outs = [out] if single else list(out)
+    heads = []
+    for o in outs:
+        if not isinstance(o, SymbolTracer):
+            raise MXNetError(
+                "graph trace: forward returned a non-traced value "
+                f"({type(o).__name__})")
+        n, idx = o._symhead
+        if id(n) not in sid:
+            # forward returned an input/param unchanged — vars are in sid
+            raise MXNetError("graph trace: output head was never recorded")
+        heads.append((sid[id(n)], idx))
+
+    state = []
+    for p, v in tc.state_updates:
+        if not isinstance(v, SymbolTracer):
+            raise MXNetError(
+                "graph trace: state update carried a concrete value")
+        pname = name_of.get(id(p))
+        if pname is None:
+            raise MXNetError(
+                f"graph trace: state update targets unknown parameter "
+                f"{getattr(p, 'name', p)!r}")
+        n, idx = v._symhead
+        state.append((pname, (sid[id(n)], idx)))
+
+    return Graph(nodes, inputs, params, heads, state, single).validate()

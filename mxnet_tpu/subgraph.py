@@ -12,12 +12,20 @@ passes here operate at the *operator graph* level — collapsing op chains
 into single registered ops (fewer dispatches in the eager Executor, one
 tape entry under autograd) and giving users the same extension point the
 reference exposes: register a backend, attach passes, call
-``optimize_for``.  Passes are pure ``Symbol -> Symbol`` functions over a
-cloned graph (the input Symbol is never mutated).
+``optimize_for``.
+
+Since ISSUE 11 the backends are sugar over the graph-compiler tier
+(:mod:`mxnet_tpu.graph`): every registered ``Symbol -> Symbol`` pass is
+wrapped as a registered graph pass, and ``optimize_for(backend)``
+resolves to a :class:`~mxnet_tpu.graph.PassPipeline` selection — ONE
+pass mechanism, one telemetry stream (``kind="graph_pass"`` compile
+events), one purity contract.  A legacy pass receives a freshly
+converted Symbol it may mutate; the caller's Symbol is never touched.
 """
 from __future__ import annotations
 
 import os
+import threading
 
 from .base import MXNetError
 from .ops.registry import OP_TABLE, register
@@ -27,15 +35,55 @@ __all__ = ["register_backend", "register_pass", "list_backends",
            "optimize_for", "clone", "fuse_linear_chain",
            "SubgraphProperty", "partition_graph"]
 
-_BACKENDS = {}
+_BACKENDS = {}          # backend name -> [registered graph-pass name, ...]
+
+# kwargs channel for optimize_for(sym, backend, **kwargs): the pipeline
+# API is Graph -> Graph, so per-invocation kwargs ride a thread-local
+# the adapters read (set only for the duration of one optimize_for)
+_PASS_KWARGS = threading.local()
+
+
+def _wrap_symbol_pass(backend, fn):
+    """Register a legacy ``Symbol -> Symbol`` pass as a graph pass."""
+    from . import graph as _graph
+
+    existing = getattr(fn, "graph_pass_name", None)
+    if existing is not None:
+        return existing
+    base = f"subgraph:{backend}:{getattr(fn, '__name__', 'pass')}"
+    name = base
+    k = 1
+    while name in _graph.pipeline.PASS_REGISTRY:
+        k += 1
+        name = f"{base}:{k}"
+
+    def adapter(g):
+        in_names = [g.nodes[i].name for i in g.inputs]
+        sym = g.to_symbol()          # fresh nodes — fn may mutate freely
+        kwargs = getattr(_PASS_KWARGS, "value", None) or {}
+        out = fn(sym, **kwargs) if _accepts_kwargs(fn) else fn(sym)
+        return _graph.Graph.from_symbol(out, input_names=in_names)
+
+    adapter.__name__ = name
+    adapter.__doc__ = fn.__doc__
+    _graph.graph_pass(name, default=False)(adapter)
+    # memoize on the ORIGINAL callable: re-registering the same pass
+    # (notebook re-runs, a backend aliased under two names) reuses the
+    # registration instead of growing PASS_REGISTRY with :N suffixes
+    fn.graph_pass_name = name
+    return name
 
 
 def register_backend(name, passes=None):
     """Register (or extend) a partitioning backend — ≙ the reference's
-    SubgraphProperty registration (subgraph_property.h)."""
+    SubgraphProperty registration (subgraph_property.h).  ``passes`` may
+    be legacy ``Symbol -> Symbol`` callables (wrapped and registered
+    into the graph-pass registry) or already-registered graph-pass
+    names."""
     _BACKENDS.setdefault(name, [])
-    if passes:
-        _BACKENDS[name].extend(passes)
+    for p in passes or ():
+        _BACKENDS[name].append(
+            p if isinstance(p, str) else _wrap_symbol_pass(name, p))
     return _BACKENDS[name]
 
 
@@ -67,15 +115,21 @@ def clone(sym):
 
 def optimize_for(sym, backend, **kwargs):
     """Apply a backend's passes; returns a new Symbol
-    (reference: Symbol.optimize_for)."""
+    (reference: Symbol.optimize_for).  Sugar for a graph-tier
+    ``PassPipeline`` over the backend's registered pass names."""
+    from . import graph as _graph
+
     if backend not in _BACKENDS:
         raise MXNetError(
             f"unknown subgraph backend {backend!r}; registered: "
             f"{list_backends()}")
-    out, _ = clone(sym)
-    for p in _BACKENDS[backend]:
-        out = p(out, **kwargs) if _accepts_kwargs(p) else p(out)
-    return out
+    pipeline = _graph.PassPipeline(_BACKENDS[backend], fixed_point=False)
+    prev = getattr(_PASS_KWARGS, "value", None)
+    _PASS_KWARGS.value = kwargs
+    try:
+        return pipeline.run_symbol(sym)
+    finally:
+        _PASS_KWARGS.value = prev
 
 
 def _accepts_kwargs(fn):

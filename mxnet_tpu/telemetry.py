@@ -505,17 +505,20 @@ _COMPILE_HIST = histogram(
     labelnames=("kind",))
 
 
-def compile_event(kind, name, elapsed_s, cause):
+def compile_event(kind, name, elapsed_s, cause, **extra):
     """Record one fresh jit trace.  ``kind``: ``op`` (dispatch cache miss),
-    ``block`` (hybridized Gluon block build), ``train_step``.  ``cause``
-    names why a new executable was needed (``new_op``/``new_shape``/
-    ``new_dtype``/``new_attrs``/``mode_change``/``recompile``/
-    ``trace_failure``/...)."""
+    ``block`` (hybridized Gluon block build), ``train_step``,
+    ``graph_pass`` (one graph-compiler pass application — ``extra``
+    carries ``nodes_before``/``nodes_after``).  ``cause`` names why a
+    new executable was needed (``new_op``/``new_shape``/``new_dtype``/
+    ``new_attrs``/``mode_change``/``recompile``/``trace_failure``/...).
+    Extra keyword fields land verbatim on the event record."""
     now = time.perf_counter()
     with _LOCK:
-        _COMPILE_EVENTS.append({"kind": kind, "name": name,
-                                "elapsed_s": float(elapsed_s),
-                                "cause": cause, "time": time.time()})
+        _COMPILE_EVENTS.append(dict({"kind": kind, "name": name,
+                                     "elapsed_s": float(elapsed_s),
+                                     "cause": cause, "time": time.time()},
+                                    **extra))
     _COMPILES_TOTAL.labels(kind=kind, cause=cause).inc()
     _COMPILE_HIST.labels(kind=kind).observe(elapsed_s)
     _chrome_span(f"compile:{kind}:{name}", now - float(elapsed_s), now,
@@ -692,7 +695,20 @@ def snapshot():
         "compile_events": events,
         "compile": {"count": int(n_compiles), "total_s": compile_s,
                     "events_kept": len(events)},
+        "graph": _graph_section(),
     }
+
+
+def _graph_section():
+    """Graph-compiler pipeline stats (pipeline runs, per-pass node
+    deltas, fused-op count).  Import is lazy and failure-tolerant: the
+    snapshot must work before (or without) the graph tier loading."""
+    try:
+        from .graph import stats_snapshot as _gs
+
+        return _gs()
+    except Exception:
+        return {}
 
 
 def reset():

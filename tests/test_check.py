@@ -82,12 +82,14 @@ def test_pass_catalog_complete():
     assert set(passes) == {"collective-safety", "collective-pairing",
                            "host-sync-hot-path", "lock-thread-hygiene",
                            "env-knob-registry", "fault-seam-integrity",
-                           "serving-hot-path", "planner-sharding"}
+                           "serving-hot-path", "planner-sharding",
+                           "graph-pass-contracts"}
     all_codes = {c for cls in passes.values() for c in cls.codes}
     assert all_codes == {"MXT001", "MXT002", "MXT003", "MXT005",
                          "MXT006", "MXT010", "MXT020", "MXT021",
                          "MXT022", "MXT030", "MXT031", "MXT032",
-                         "MXT040", "MXT050", "MXT060"}
+                         "MXT040", "MXT050", "MXT060", "MXT070",
+                         "MXT071"}
 
 
 def test_parse_error_reported_not_fatal(tmp_path):
@@ -507,6 +509,87 @@ def test_mxt060_noqa_waiver(tmp_path):
             return P("dp")
         """)
     assert codes_at(check(tmp_path), "MXT060") == []
+
+
+# -- MXT070/071 graph-compiler pass contracts --------------------------------
+def test_mxt070_impure_graph_pass_flagged(tmp_path):
+    """A registered pass mutating its INPUT graph (attr write, list
+    mutator, subscript store) is flagged; the compliant twin working on
+    graph.copy() stays silent."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/graph/rogue.py", """
+        from .pipeline import graph_pass
+
+
+        @graph_pass("rogue_pass")
+        def rogue_pass(graph):
+            for n in graph.nodes:
+                n.attrs["hit"] = True          # line 7: subscript store
+            nodes = graph.nodes
+            nodes.append(None)                 # line 9: list mutator
+            graph.single = False               # line 10: attr write
+            return graph
+
+
+        @graph_pass("clean_pass")
+        def clean_pass(graph):
+            g = graph.copy()
+            for n in g.nodes:
+                n.attrs["hit"] = True
+            g.nodes.append(None)
+            g.single = False
+            return g
+        """)
+    hits = codes_at(check(tmp_path), "MXT070")
+    assert ("mxnet_tpu/graph/rogue.py", 7) in hits
+    assert ("mxnet_tpu/graph/rogue.py", 9) in hits
+    assert ("mxnet_tpu/graph/rogue.py", 10) in hits
+    assert len(hits) == 3, hits
+
+
+def test_mxt070_noqa_waiver(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/graph/special.py", """
+        from .pipeline import graph_pass
+
+
+        @graph_pass("stamp_pass")
+        def stamp_pass(graph):
+            # mxtpu: noqa[MXT070] deliberate in-place stamp for a test
+            graph.single = True
+            return graph.copy()
+        """)
+    assert codes_at(check(tmp_path), "MXT070") == []
+
+
+def test_mxt071_scheduled_but_unregistered_pass(tmp_path):
+    """A pass name scheduled via a *_PASSES literal (or a literal
+    PassPipeline list) without a matching @graph_pass registration
+    fails the gate; registered names stay silent."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/graph/sched.py", """
+        from .pipeline import graph_pass
+
+        DEFAULT_PASSES = ("real_pass", "ghost_pass")
+
+
+        @graph_pass("real_pass")
+        def real_pass(graph):
+            return graph.copy()
+
+
+        def build():
+            from .pipeline import PassPipeline
+
+            return PassPipeline(["real_pass", "phantom"])
+        """)
+    hits = codes_at(check(tmp_path), "MXT071")
+    paths = {p for p, _ in hits}
+    assert paths == {"mxnet_tpu/graph/sched.py"}
+    msgs = [f.message for f in check(tmp_path) if f.code == "MXT071"]
+    assert any("ghost_pass" in m for m in msgs)
+    assert any("phantom" in m for m in msgs)
+    assert not any("real_pass" in m for m in msgs)
 
 
 # -- MXT020-022 lock/thread hygiene -----------------------------------------

@@ -6,11 +6,13 @@ namespaced by decade: MXT00x collective-safety (001-003 general,
 005-006 reduce-scatter pairing / bucket keying), MXT01x hot-path,
 MXT02x lock/thread, MXT03x env knobs, MXT04x fault seams, MXT05x
 serving steady-state (no traces outside AOT warmup), MXT06x sharding
-planner (no raw PartitionSpec/NamedSharding outside mxnet_tpu/parallel/).
+planner (no raw PartitionSpec/NamedSharding outside mxnet_tpu/parallel/),
+MXT07x graph-compiler pass contracts (purity + registration closure).
 """
 from . import collectives  # noqa: F401
 from . import envknobs  # noqa: F401
 from . import faultseams  # noqa: F401
+from . import graphpass  # noqa: F401
 from . import hotpath  # noqa: F401
 from . import pairing  # noqa: F401
 from . import planner  # noqa: F401

@@ -1,0 +1,209 @@
+"""MXT070/071: graph-compiler pass contracts.
+
+The graph tier (ISSUE 11, ``mxnet_tpu/graph/``) rests on two machine-
+checkable promises:
+
+- **MXT070 — passes are pure.**  A registered graph pass
+  (``@graph_pass(...)``) is a ``Graph -> Graph`` FUNCTION: it must never
+  mutate the input graph's nodes, attrs, edges, or head lists.  The
+  compliant pattern is ``g = graph.copy()`` (or a rebuild) and mutation
+  of the copy only.  Detection is a taint scan in the style of MXT060's
+  construction scan: the first parameter is tainted; attribute reads,
+  subscripts and iteration propagate taint; a *call* result (``.copy()``,
+  ``Graph(...)``, ``Node(...)``) is fresh.  Flagged shapes on a tainted
+  receiver: attribute assignment (``n.inputs = ...``), subscript
+  assignment (``n.attrs[k] = ...``), aug-assignment, and mutating method
+  calls (``.append``/``.update``/``.pop``/...).
+
+- **MXT071 — every pass reachable from PassPipeline is registered.**
+  Pass schedules are built from *names* (``DEFAULT_PASSES``, literal
+  lists handed to ``PassPipeline([...])``); a name that no
+  ``@graph_pass("name")`` decorator registers would fail at runtime on
+  whatever machine first builds that pipeline — the checker fails it at
+  lint time instead.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Pass, register
+
+_MUTATORS = {"append", "extend", "insert", "remove", "clear", "pop",
+             "popitem", "update", "setdefault", "sort", "reverse",
+             "add", "discard"}
+
+
+def _decorator_pass_name(dec):
+    """The literal pass name when ``dec`` is ``graph_pass("name"[, ...])``
+    (any receiver spelling); None otherwise."""
+    if not isinstance(dec, ast.Call):
+        return None
+    f = dec.func
+    tail = f.id if isinstance(f, ast.Name) else \
+        f.attr if isinstance(f, ast.Attribute) else None
+    if tail != "graph_pass":
+        return None
+    if dec.args and isinstance(dec.args[0], ast.Constant) and \
+            isinstance(dec.args[0].value, str):
+        return dec.args[0].value
+    return None
+
+
+def _root_name(node):
+    """The Name at the root of an Attribute/Subscript chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _TaintScan(ast.NodeVisitor):
+    """Linear taint propagation over one pass function's body."""
+
+    def __init__(self, param):
+        self.tainted = {param}
+        self.hits = []       # (ast node, description)
+
+    def _expr_tainted(self, node):
+        """An expression yields a tainted object when it is a read
+        (name/attribute/subscript/iteration) rooted at a tainted name.
+        A Call produces a FRESH object (copy()/Graph()/Node()/list())."""
+        if isinstance(node, ast.Call):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            return self._expr_tainted(node.value)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._expr_tainted(e) for e in node.elts)
+        return False
+
+    def _bind(self, target, tainted):
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, tainted)
+
+    def visit_Assign(self, node):
+        src_tainted = self._expr_tainted(node.value)
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and \
+                    self._expr_tainted(t.value):
+                self.hits.append((node, f"assigns .{t.attr} on the input "
+                                        "graph"))
+            elif isinstance(t, ast.Subscript) and \
+                    self._expr_tainted(t.value):
+                self.hits.append((node, "subscript-assigns into the input "
+                                        "graph"))
+            else:
+                self._bind(t, src_tainted)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        t = node.target
+        if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                self._expr_tainted(t.value):
+            self.hits.append((node, "aug-assigns into the input graph"))
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._bind(node.target, self._expr_tainted(node.iter))
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS and \
+                self._expr_tainted(f.value):
+            self.hits.append((node, f".{f.attr}(...) mutates the input "
+                                    "graph"))
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node):   # pragma: no cover - via generic
+        self._bind(node.target, self._expr_tainted(node.iter))
+        self.generic_visit(node)
+
+
+@register
+class GraphPassContracts(Pass):
+    name = "graph-pass-contracts"
+    codes = {
+        "MXT070": "graph pass mutates its input graph",
+        "MXT071": "pipeline-reachable graph pass is not registered",
+    }
+
+    def __init__(self):
+        self._registered = set()     # names from @graph_pass("...")
+        self._referenced = []        # (name, path, line, scope)
+
+    def run(self, ctx, mod):
+        findings = []
+        for node in ast.walk(mod.tree):
+            # registration sites + purity scan
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                pname = None
+                for dec in node.decorator_list:
+                    pname = _decorator_pass_name(dec) or pname
+                if pname is None:
+                    continue
+                self._registered.add(pname)
+                if not node.args.args:
+                    continue
+                scan = _TaintScan(node.args.args[0].arg)
+                for stmt in node.body:
+                    scan.visit(stmt)
+                for hit, what in scan.hits:
+                    findings.append(Finding(
+                        code="MXT070", path=mod.relpath, line=hit.lineno,
+                        message=f"graph pass {pname!r} {what}",
+                        hint="passes are pure Graph -> Graph: start from "
+                             "graph.copy() (or rebuild node lists) and "
+                             "mutate only the copy; the input graph may "
+                             "be cached and replayed by another consumer",
+                        scope=mod.qualname(hit), key=f"impure:{pname}",
+                        col=hit.col_offset))
+            # schedule references: DEFAULT_PASSES-style literals in the
+            # graph package, and literal lists fed to PassPipeline(...)
+            if isinstance(node, ast.Assign) and \
+                    mod.relpath.startswith("mxnet_tpu/graph/"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and \
+                            t.id.endswith("_PASSES") and \
+                            isinstance(node.value, (ast.Tuple, ast.List)):
+                        for e in node.value.elts:
+                            if isinstance(e, ast.Constant) and \
+                                    isinstance(e.value, str):
+                                self._referenced.append(
+                                    (e.value, mod.relpath, e.lineno,
+                                     mod.qualname(e)))
+            if isinstance(node, ast.Call):
+                f = node.func
+                tail = f.id if isinstance(f, ast.Name) else \
+                    f.attr if isinstance(f, ast.Attribute) else None
+                if tail == "PassPipeline" and node.args and \
+                        isinstance(node.args[0], (ast.Tuple, ast.List)):
+                    for e in node.args[0].elts:
+                        if isinstance(e, ast.Constant) and \
+                                isinstance(e.value, str):
+                            self._referenced.append(
+                                (e.value, mod.relpath, e.lineno,
+                                 mod.qualname(e)))
+        return findings
+
+    def finalize(self, ctx):
+        findings = []
+        for name, path, line, scope in self._referenced:
+            if name in self._registered:
+                continue
+            findings.append(Finding(
+                code="MXT071", path=path, line=line,
+                message=f"pass name {name!r} is scheduled but no "
+                        f"@graph_pass({name!r}) registration exists",
+                hint="register the pass (@graph_pass) in "
+                     "mxnet_tpu/graph/passes.py or fix the name — an "
+                     "unregistered name fails at the first pipeline "
+                     "build on someone else's machine",
+                scope=scope, key=f"unregistered-pass:{name}"))
+        return findings
