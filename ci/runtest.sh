@@ -11,7 +11,10 @@
 #               death, retry exhaustion) + ambient-MXNET_FAULT_SPEC smoke
 #               + preemption/watchdog lifecycle smoke (SIGTERM mid-run ->
 #               published checkpoint -> bit-identical resume; wedged step
-#               -> stack-dump diagnosis + abort)
+#               -> stack-dump diagnosis + abort) + elasticity smoke
+#               (real child shrinks dp=4->2 mid-run and reshards LIVE,
+#               bit-identical; warm restart performs zero fresh traces
+#               and beats cold restart-to-first-step)
 #   telemetry   runtime-telemetry smoke (train loop with telemetry +
 #               profiler on; Prometheus/snapshot/compile-event checks)
 #               + the telemetry unit suite
@@ -94,7 +97,14 @@ case "$LANE" in
     #    resume must be bit-identical; a wedged step must trip the
     #    watchdog (diagnosis file + stall counter + abort status)
     JAX_PLATFORMS=cpu python ci/preemption_smoke.py
-    # 3) the fault suite incl. slow scenarios (real SIGKILL of a worker).
+    # 3) zero-downtime elasticity (ISSUE 13): a real child pod shrinks
+    #    dp=4 -> dp=2 mid-run and reshards IN-FLIGHT (transfer-plan
+    #    digest identical across two children), resuming bit-identically
+    #    with no checkpoint round trip; a warm restart against the
+    #    shared compile cache performs ZERO fresh traces and beats the
+    #    cold restart-to-first-step
+    JAX_PLATFORMS=cpu python ci/elastic_smoke.py
+    # 4) the fault suite incl. slow scenarios (real SIGKILL of a worker).
     #    The unit lane also runs this file; the repeat is deliberate —
     #    the chaos stage must stay green/triagable on its own (ISSUE 2)
     #    and is cheap (~20s).  test_checkpoint.py is NOT repeated.

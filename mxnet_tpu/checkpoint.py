@@ -130,6 +130,7 @@ class CheckpointManager:
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.logger = logger or _LOGGER
+        self._compile_cache = None
         # verify() verdict cache: step -> {file: (size, mtime_ns)} at the
         # time the step last hashed clean
         self._valid_steps = {}
@@ -570,6 +571,22 @@ class CheckpointManager:
             if os.path.exists(tpath):
                 trainer.load_states(tpath)
 
+    @property
+    def compile_cache(self):
+        """The warm-start compile cache living beside these checkpoints
+        (``<directory>/compile_cache``; see :mod:`mxnet_tpu.
+        compile_cache`).  None when ``MXNET_COMPILE_CACHE=0``.  Lazy —
+        constructing a manager must not touch the cache dir.  Safe for
+        every process to share: entries are content-addressed and
+        published by atomic rename, so concurrent writers converge on
+        identical files."""
+        from . import compile_cache as _cc
+
+        if self._compile_cache is None and _cc.enabled():
+            self._compile_cache = _cc.CompileCache(
+                os.path.join(self.directory, "compile_cache"))
+        return self._compile_cache
+
     def read_meta(self, step):
         with open(os.path.join(self._step_dir(step), "meta.json")) as f:
             return json.load(f)
@@ -601,7 +618,8 @@ class CheckpointManager:
 
 
 def run_with_recovery(train_fn, manager, max_restarts=3,
-                      should_retry=None, logger=None, backoff_ms=None):
+                      should_retry=None, logger=None, backoff_ms=None,
+                      resharder=None):
     """Supervised training loop: ``train_fn(start_step, manager)`` runs to
     completion or raises; on a retryable failure it is re-invoked from the
     latest checkpoint (elastic semantics for preemptible TPU jobs).
@@ -626,6 +644,15 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
       restart, and re-raises — the caller translates it to
       ``sys.exit(lifecycle.EXIT_PREEMPTED)`` and the external scheduler
       relaunches the job, which resumes bit-identically.
+    - ``resharder(exc) -> step | None`` is the zero-downtime elasticity
+      hook (``lifecycle.elastic_resharder`` builds one): when the
+      surviving in-process state is intact — and every SPMD peer AGREES
+      it is — it live-reshards that state to the (possibly resized)
+      mesh and returns the step the state corresponds to, so the next
+      ``train_fn(start, manager)`` skips the checkpoint disk round trip
+      entirely.  Returning None (state damaged, peers disagree, or the
+      reshard itself failed) falls back to the checkpoint path — the
+      choice is automatic, per failure.
 
     Returns train_fn's result."""
     from .lifecycle import GracefulExit
@@ -638,9 +665,16 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
     # start past state it never loaded (silent step/state skew)
     progress = getattr(manager, "latest_valid_step", manager.latest_step)
     restarts = 0
-    last_failed_step = None
+    # per-path progress markers (see the reset logic below — live and
+    # checkpoint steps are different clocks).  The checkpoint marker
+    # seeds from the supervisor's starting state so the FIRST failure
+    # already gets credit for any checkpoint published since launch.
+    last_ckpt_step = progress() or 0
+    last_live_step = None
+    live_start = None
     while True:
-        start = progress() or 0
+        start = live_start if live_start is not None else progress() or 0
+        live_start = None
         try:
             result = train_fn(start, manager)
             # a final async save may still be staging: join before the
@@ -682,20 +716,65 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
             if join is not None:
                 join(raise_=False)
             step_now = progress() or 0
-            if last_failed_step is not None and step_now > last_failed_step:
-                log.info("checkpoint advanced %s -> %s between failures; "
-                         "restart budget reset", last_failed_step, step_now)
+            if resharder is not None:
+                # live elasticity: reshard surviving state instead of
+                # restoring from disk when the hook (with peer
+                # agreement) says it is intact; any failure inside the
+                # hook falls back to the checkpoint path.  Consulted
+                # BEFORE the budget verdict: a live-resharded step is
+                # progress exactly like a published checkpoint, so a
+                # job advancing through preemptions between checkpoint
+                # intervals must not exhaust the budget and die
+                # "stuck" at a step it long passed.
+                from .parallel import resharding as _resharding
+
+                try:
+                    live_start = resharder(e)
+                except Exception as re:
+                    live_start = None
+                    log.warning("live resharder failed (%r); falling "
+                                "back to checkpoint restore", re)
+                if live_start is not None:
+                    _resharding.record_live_reshard()
+                    log.info("live reshard accepted: resuming from "
+                             "in-process state at step %s (checkpoint "
+                             "would have been step %s)", live_start,
+                             step_now)
+                else:
+                    _resharding.record_reshard_fallback()
+            # progress resets the budget — only repeated failures at
+            # the SAME point are a crash loop.  Each recovery path is
+            # compared against ITS OWN last marker: a live step and a
+            # checkpoint step are different clocks (a lost live reshard
+            # can outrun the checkpoints; later checkpoint advances
+            # below it are still real progress and must still reset).
+            # Both quantities are peer-agreed/deterministic, so the
+            # verdict is uniform across SPMD peers.
+            if live_start is not None:
+                progressed = last_live_step is not None and \
+                    live_start > last_live_step
+                last_live_step = live_start
+                effective = live_start
+            else:
+                progressed = step_now > last_ckpt_step
+                last_ckpt_step = step_now
+                effective = step_now
+            if progressed:
+                log.info("progress advanced to step %s between "
+                         "failures (%s); restart budget reset",
+                         effective,
+                         "live reshard" if live_start is not None
+                         else "checkpoint")
                 restarts = 0
-            last_failed_step = step_now
             restarts += 1
             _RESTARTS_TOTAL.inc()
             if restarts > max_restarts:
                 raise MXNetError(
                     f"training failed after {max_restarts} restarts "
-                    f"without checkpoint progress (stuck at step "
-                    f"{step_now}; last error: {e!r})") from e
+                    f"without progress (stuck at step "
+                    f"{effective}; last error: {e!r})") from e
             delay = fault.backoff_delay(restarts - 1, backoff_ms)
             log.warning("restart %d/%d from step %s in %.3fs after: %r",
-                        restarts, max_restarts, step_now, delay, e)
+                        restarts, max_restarts, effective, delay, e)
             if delay > 0:
                 time.sleep(delay)

@@ -1,0 +1,274 @@
+"""Persistent warm-start compile cache: lowered executables on disk,
+beside the checkpoints they resume with.
+
+The second half of the zero-downtime-elasticity story (ROADMAP): a
+restarted job used to pay a full retrace-and-compile of every
+TrainStep/serving signature even when nothing about the program
+changed.  This module persists the *serialized lowered executable*
+(``jax.export``) keyed by the same vocabulary the PR 1/PR 8 dispatch
+layers already use, so a warm resume loads executables instead of
+tracing Python — **zero fresh traces**, asserted by the PR 3
+compile-event tracer (a cache hit records a ``compile_cache`` hit
+counter, never a compile event, because no trace happened).
+
+Key = sha256 over:
+
+- the consumer's :func:`~mxnet_tpu.ndarray.dispatch_cache.
+  signature_key`-style components (avals + static extras + AMP epoch +
+  ctx kind),
+- the governing :class:`~mxnet_tpu.parallel.planner.ShardingPlan`
+  digest (a re-planned mesh must never serve the old executable),
+- the jax/jaxlib version fingerprint plus this module's format version
+  (an upgraded runtime silently starts cold),
+- ``MXNET_COMPILE_CACHE_SALT`` (manual invalidation for Python-side
+  semantic changes the signature cannot see — a rewritten loss closure
+  keeps its qualname; bump the salt or clear the directory).
+
+Entry format: one file per key, ``<keyhash>.exe`` = a JSON header line
+(payload sha256, sizes, jax fingerprint, creation time) + the
+serialized executable bytes.  Written atomically (tmp + rename, the
+checkpoint discipline), verified on read: **a corrupt, truncated, or
+version-mismatched entry is a silent miss, never a crash** — the
+consumer simply traces fresh and overwrites it.
+
+Consumers: ``TrainStep(compile_cache=...)``,
+``ServingEngine(..., compile_cache=...)``, both defaulting to the
+session cache (``MXNET_COMPILE_CACHE_DIR``) when one is configured;
+``CheckpointManager.compile_cache`` keeps one beside its checkpoints.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+import time
+
+from . import env as _env
+from . import telemetry as _telemetry
+
+__all__ = ["CompileCache", "enabled", "default_cache", "resolve",
+           "aval_signature"]
+
+_LOGGER = logging.getLogger(__name__)
+
+# bump when the on-disk format or the wrapping semantics change: old
+# entries silently miss instead of deserializing garbage
+_FORMAT_VERSION = 1
+
+_HITS = _telemetry.counter(
+    "mxnet_compile_cache_hits_total",
+    "warm-start executables served from the persistent compile cache "
+    "(each one is a trace+compile that did NOT happen)")
+_MISSES = _telemetry.counter(
+    "mxnet_compile_cache_misses_total",
+    "compile-cache lookups that found no usable entry")
+_CORRUPT = _telemetry.counter(
+    "mxnet_compile_cache_corrupt_total",
+    "cache entries rejected by verification (corrupt/truncated/"
+    "version-mismatched) — each one degraded to a clean miss")
+_STORES = _telemetry.counter(
+    "mxnet_compile_cache_stores_total",
+    "executables serialized into the persistent compile cache")
+
+
+def enabled():
+    """Whether compile caching may run at all (``MXNET_COMPILE_CACHE``,
+    default on)."""
+    return _env.compile_cache_enabled()
+
+
+_DEFAULT = None
+_DEFAULT_DIR = None
+
+
+def default_cache():
+    """The session-default cache from ``MXNET_COMPILE_CACHE_DIR`` (None
+    when unset or caching is disabled)."""
+    global _DEFAULT, _DEFAULT_DIR
+    if not enabled():
+        return None
+    d = _env.compile_cache_dir()
+    if not d:
+        return None
+    if _DEFAULT is None or _DEFAULT_DIR != d:
+        _DEFAULT = CompileCache(d)
+        _DEFAULT_DIR = d
+    return _DEFAULT
+
+
+def resolve(explicit):
+    """The cache a consumer should use: an explicit ``CompileCache``
+    argument wins; otherwise the session default; None = no caching."""
+    if explicit is not None:
+        return explicit if enabled() else None
+    return default_cache()
+
+
+def _jax_fingerprint():
+    import jax
+    import jaxlib
+
+    return f"jax={jax.__version__};jaxlib={jaxlib.__version__}" \
+           f";fmt={_FORMAT_VERSION}"
+
+
+def aval_signature(tree):
+    """Stable (treedef, leaves) fingerprint of a pytree of arrays /
+    ShapeDtypeStructs, sharding included — the aval half of a cache
+    key."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (str(treedef),
+            tuple((tuple(v.shape), str(v.dtype),
+                   str(getattr(v, "sharding", None)))
+                  for v in leaves))
+
+
+class CompileCache:
+    """One on-disk executable cache directory (content-addressed,
+    atomic-publish, sha256-verified)."""
+
+    def __init__(self, directory, logger=None):
+        self.directory = directory
+        self.logger = logger or _LOGGER
+
+    # -- keys --------------------------------------------------------------
+    def key(self, name, components, plan_digest=None):
+        """sha256 key for one executable: ``name`` (consumer kind +
+        label), ``components`` (any repr-stable tuple — signature_key
+        output, aval signatures, static config), the plan digest, the
+        jax fingerprint, and the salt knob."""
+        doc = repr((str(name), components, plan_digest or "none",
+                    _jax_fingerprint(), _env.compile_cache_salt()))
+        return hashlib.sha256(doc.encode()).hexdigest()
+
+    def _path(self, key):
+        return os.path.join(self.directory, f"{key}.exe")
+
+    # -- raw entries -------------------------------------------------------
+    def get_bytes(self, key):
+        """The verified payload for ``key``, or None (miss).  Every
+        failure mode — missing file, torn header, truncated payload,
+        checksum mismatch, fingerprint drift — is a SILENT miss.
+        Counts misses/corruption only; a HIT is counted by
+        :meth:`load_executable` once an executable is actually served —
+        a verified blob that later fails to deserialize must end up in
+        the miss column, not the hit column."""
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                header = json.loads(f.readline())
+                payload = f.read()
+        except (OSError, ValueError):
+            _MISSES.inc()
+            return None
+        try:
+            ok = (header.get("fingerprint") == _jax_fingerprint()
+                  and header.get("size") == len(payload)
+                  and header.get("sha256") ==
+                  hashlib.sha256(payload).hexdigest())
+        except Exception:
+            ok = False
+        if not ok:
+            _CORRUPT.inc()
+            _MISSES.inc()
+            self.logger.warning(
+                "compile cache entry %s failed verification; treating "
+                "as a miss (it will be re-traced and overwritten)", path)
+            return None
+        return payload
+
+    def put_bytes(self, key, payload, meta=None):
+        """Atomically publish ``payload`` under ``key`` (tmp + fsync +
+        rename — concurrent writers converge on identical files, a
+        crash mid-write leaves no visible entry)."""
+        os.makedirs(self.directory, exist_ok=True)
+        header = {"sha256": hashlib.sha256(payload).hexdigest(),
+                  "size": len(payload),
+                  "fingerprint": _jax_fingerprint(),
+                  "time": time.time()}
+        if meta:
+            header["meta"] = meta
+        fd, tmp = tempfile.mkstemp(dir=self.directory, prefix=".tmp_cc_")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(json.dumps(header).encode() + b"\n")
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path(key))
+        except OSError as e:
+            # a full/read-only disk must not kill training — the cache
+            # is an accelerator, not a dependency
+            self.logger.warning("compile cache store failed: %r", e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        _STORES.inc()
+        return True
+
+    # -- executables -------------------------------------------------------
+    def load_executable(self, key):
+        """Deserialize the cached executable for ``key`` into a
+        callable (``jax.jit`` of the exported artifact's call — fast
+        steady-state dispatch, NO trace of the original Python).  Any
+        deserialization failure is a silent miss: jax.export artifacts
+        embed their own compatibility checks, and an incompatible one
+        must degrade to a fresh trace, not a crash."""
+        blob = self.get_bytes(key)
+        if blob is None:
+            return None
+        try:
+            import jax
+            from jax import export as _export
+
+            exported = _export.deserialize(blob)
+            fn = jax.jit(exported.call)
+        except Exception as e:
+            # byte-verified but undeserializable: a MISS (the consumer
+            # traces fresh), counted as such — hits must only ever mean
+            # "a trace+compile did not happen"
+            _CORRUPT.inc()
+            _MISSES.inc()
+            self.logger.warning(
+                "compile cache entry %s verified but failed to rebuild "
+                "an executable (%r); treating as a miss",
+                self._path(key), e)
+            return None
+        _HITS.inc()
+        return fn
+
+    def store_executable(self, key, jit_fn, *avals, **kw_avals):
+        """Serialize ``jit_fn`` lowered at ``avals`` and publish it
+        under ``key``.  The export re-traces the function once (cold
+        path, already paying a trace) — never raises: an unexportable
+        program (unsupported primitive, platform quirk) just leaves the
+        cache cold."""
+        try:
+            from jax import export as _export
+
+            exported = _export.export(jit_fn)(*avals, **kw_avals)
+            return self.put_bytes(key, exported.serialize())
+        except Exception as e:
+            self.logger.warning(
+                "compile cache: could not export executable for key "
+                "%s... (%r); entry skipped", key[:12], e)
+            return False
+
+    def stats(self):
+        """Entry count + bytes on disk (observability helper)."""
+        n, total = 0, 0
+        try:
+            for name in os.listdir(self.directory):
+                if name.endswith(".exe"):
+                    n += 1
+                    total += os.path.getsize(
+                        os.path.join(self.directory, name))
+        except OSError:
+            pass
+        return {"entries": n, "bytes": total, "directory": self.directory}

@@ -27,6 +27,14 @@ Seams (each named check-point is called on the real code path):
                             treated as a delivered preemption signal)
 ``watchdog.stall``          watchdog poll (an armed fault is treated as an
                             expired step deadline)
+``serving.admit``           serving-engine request admission (a tripped
+                            admit requeues the request; nothing is lost)
+``serving.decode_step``     serving-engine batched decode step, checked
+                            BEFORE any KV/sequence mutation (the loop
+                            absorbs the failure and retries the step)
+``resharding.transfer``     live-resharding transfer execution (the
+                            transfer is pure w.r.t. its inputs, so a trip
+                            costs one supervised retry, never torn state)
 ==========================  =================================================
 
 Arming faults:
@@ -70,7 +78,8 @@ __all__ = ["SEAMS", "check", "guard", "inject", "stats", "reset_stats",
 SEAMS = ("checkpoint.write", "checkpoint.fsync", "checkpoint.publish",
          "dataloader.worker", "kvstore.push", "kvstore.pull",
          "collectives.allreduce", "distributed.init",
-         "lifecycle.sigterm", "watchdog.stall")
+         "lifecycle.sigterm", "watchdog.stall",
+         "serving.admit", "serving.decode_step", "resharding.transfer")
 
 _LOGGER = logging.getLogger(__name__)
 _LOCK = threading.Lock()

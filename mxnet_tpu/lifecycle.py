@@ -70,6 +70,7 @@ __all__ = ["GracefulExit", "EXIT_PREEMPTED", "EXIT_FORCED", "EXIT_STALLED",
            "uninstall_signal_handlers", "cancel_grace_deadline",
            "publish_final_checkpoint",
            "capture_train_state", "restore_train_state",
+           "elastic_resharder",
            "Watchdog", "start_watchdog", "stop_watchdog", "reset"]
 
 _LOGGER = logging.getLogger(__name__)
@@ -402,6 +403,60 @@ def restore_train_state(state, dataloader=None, scaler=None):
     if scaler is not None and state.get("loss_scaler") is not None:
         scaler.load_state_dict(state["loss_scaler"])
     return state.get("step")
+
+
+# --------------------------------------------------------------------------
+# zero-downtime elasticity: the live-reshard recovery hook
+# --------------------------------------------------------------------------
+def elastic_resharder(check_fn, reshard_fn, logger=None):
+    """Build a ``run_with_recovery(resharder=...)`` callback from two
+    caller pieces:
+
+    - ``check_fn(exc) -> (ok, step)`` — is THIS process's surviving
+      in-memory state intact, and which step does it correspond to?
+      Pure local verdict: no collectives, no device work.
+    - ``reshard_fn(step) -> step`` — move the surviving state to the
+      (possibly resized) mesh, normally via
+      :mod:`~mxnet_tpu.parallel.resharding` (``apply_transfer`` /
+      ``ZeroBucketEngine.reshard``); returns the resume step.
+
+    The glue this helper owns is the SPMD agreement: exactly ONE
+    collective (``resharding.peers_agree_intact``) decides whether
+    every peer's state survived — issued unconditionally on every
+    process, so collective counts stay uniform no matter which peers
+    are damaged.  A ``check_fn`` that RAISES (probing torn state is
+    exactly when it might) is treated as a not-intact vote with the
+    collective still issued — letting the exception skip it would
+    strand every other peer inside the agreement.  Only a unanimous
+    yes takes the live path; any veto falls back to the checkpoint
+    restore.  A ``reshard_fn`` failure AFTER unanimous agreement
+    propagates to run_with_recovery's fallback; multi-process, a
+    mid-transfer failure there is the PR 2 escalation class (the
+    transfer's own collectives desync) and resolves through the
+    whole-job restart, exactly like any other torn collective.
+    Single-process jobs skip the collective and the local verdict
+    decides."""
+    log = logger or _LOGGER
+
+    def _resharder(exc):
+        try:
+            ok, step = check_fn(exc)
+        except Exception as ce:
+            # the peers are (or will be) blocked in the agreement
+            # collective: vote not-intact rather than skip the vote
+            log.warning("elastic check_fn raised (%r); voting "
+                        "not-intact", ce)
+            ok, step = False, None
+        from .parallel.resharding import peers_agree_intact
+
+        agreed = peers_agree_intact(bool(ok))
+        if not agreed:
+            log.info("live reshard declined: surviving state not "
+                     "intact on every peer (local ok=%s)", bool(ok))
+            return None
+        return reshard_fn(step)
+
+    return _resharder
 
 
 # --------------------------------------------------------------------------

@@ -134,7 +134,8 @@ class TrainStep:
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, param_sharding="replicated", extra_param_specs=None,
                  batch_axes=("dp", "fsdp"), donate=True, train_mode=True,
-                 dtype=None, pipeline=None, remat=False, plan=None):
+                 dtype=None, pipeline=None, remat=False, plan=None,
+                 compile_cache=None):
         """``pipeline``: dict enabling pipeline parallelism over a mesh
         axis — {'num_microbatches': M, 'axis': 'pp', 'schedule':
         'gpipe'|'1f1b', 'remat_stage': bool}.  The net must implement
@@ -406,6 +407,35 @@ class TrainStep:
         self._rng_seed = 0
         self.step_count = 0      # steps taken (lifecycle train_state)
         self._seen_sigs = set()  # telemetry: (x, y) avals already compiled
+        # warm-start compile cache (mxnet_tpu/compile_cache.py): a
+        # cached lowered executable for this exact signature skips the
+        # trace entirely on resume — zero fresh traces, compile-tracer
+        # visible only as a cache hit.  Static config the avals cannot
+        # see rides the key: optimizer/pipeline/AMP config, the net's
+        # structural repr (gluon reprs carry layer classes, units and
+        # activations, so an architecture edit under unchanged param
+        # shapes misses), and loss_fn's qualname.  Python BODY edits
+        # under an unchanged structure/name are the one thing no key
+        # component can see — bump MXNET_COMPILE_CACHE_SALT (README
+        # "Elasticity" documents the invalidation matrix).
+        from .. import compile_cache as _ccache
+
+        self._cc = _ccache.resolve(compile_cache)
+        self._cc_fns = {}        # batch sig -> cached callable | None
+        self._cc_pending = {}    # batch sig -> (key, avals) to store
+        pipe_key = None
+        if self._pipeline is not None:
+            pipe_key = (self._pipeline["M"], self._pipeline["axis"],
+                        self._pipeline["schedule"],
+                        self._pipeline["remat_stage"],
+                        self._pipeline["batch_axes"])
+        self._cc_extra = (
+            optimizer, tuple(sorted(opt_params.items())), str(dtype),
+            bool(remat), pipe_key, bool(train_mode), bool(donate),
+            getattr(loss_fn, "__qualname__", None) or repr(loss_fn),
+            " ".join(repr(net).split()),
+            tuple(sorted((k, str(v)) for k, v in
+                         (extra_param_specs or {}).items())))
 
     @property
     def params(self):
@@ -426,6 +456,67 @@ class TrainStep:
 
         return stage_leaf(v, self._batch_shard)
 
+    @staticmethod
+    def _plain_tree(t):
+        """Canonicalize mapping containers to plain dicts.  The step's
+        state trees drift between OrderedDict and dict across calls
+        (``step`` rebuilds ``rest_params`` with ``dict()``); jax.jit
+        shrugs, but an exported artifact's calling convention is
+        structure-STRICT — so the compile-cache path speaks plain dicts
+        on both the export and every invocation.  Key-based flattening
+        means the leaf mapping is unchanged."""
+        if isinstance(t, dict):
+            return {k: TrainStep._plain_tree(v) for k, v in t.items()}
+        if isinstance(t, tuple):
+            return tuple(TrainStep._plain_tree(v) for v in t)
+        if isinstance(t, list):
+            return [TrainStep._plain_tree(v) for v in t]
+        return t
+
+    def _cc_avals(self, rng, x, y):
+        """ShapeDtypeStruct pytree mirroring one _step invocation's
+        operands (shardings preserved — a resharded layout must key
+        differently), canonicalized to plain-dict structure."""
+        import jax
+
+        def aval(v):
+            return jax.ShapeDtypeStruct(
+                tuple(v.shape), v.dtype,
+                sharding=getattr(v, "sharding", None))
+
+        return self._plain_tree((
+            jax.tree_util.tree_map(aval, self.train_params),
+            jax.tree_util.tree_map(aval, self.rest_params),
+            jax.tree_util.tree_map(aval, self.opt_state),
+            aval(rng),
+            jax.ShapeDtypeStruct(tuple(x.shape), x.dtype,
+                                 sharding=getattr(x, "sharding", None)),
+            jax.ShapeDtypeStruct(tuple(y.shape), y.dtype,
+                                 sharding=getattr(y, "sharding",
+                                                  None))))
+
+    def _cc_lookup(self, sig, rng, x, y):
+        """Resolve the cached executable for one batch signature (once
+        per sig): a hit replaces self._step for that sig; a miss
+        schedules an export right after the first (tracing) call."""
+        import jax.numpy as jnp
+
+        from .. import compile_cache as _ccache
+
+        x = x if hasattr(x, "shape") else jnp.asarray(x)
+        y = y if hasattr(y, "shape") else jnp.asarray(y)
+        avals = self._cc_avals(rng, x, y)
+        key = self._cc.key(
+            f"train_step:{type(self._net).__name__}",
+            (_ccache.aval_signature(avals), self._cc_extra),
+            plan_digest=self._plan.digest()
+            if self._plan is not None else None)
+        fn = self._cc.load_executable(key)
+        self._cc_fns[sig] = fn
+        if fn is None:
+            self._cc_pending[sig] = (key, avals)
+        return fn
+
     def __call__(self, x, y):
         from jax import random as jr
 
@@ -440,14 +531,34 @@ class TrainStep:
         # (past the cap fresh compiles simply go unrecorded)
         sig = (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "")),
                tuple(getattr(y, "shape", ())), str(getattr(y, "dtype", "")))
+        step_fn = self._step
+        if self._cc is not None:
+            cached = self._cc_fns[sig] if sig in self._cc_fns else \
+                self._cc_lookup(sig, rng, x, y)
+            if cached is not None:
+                # warm start: no trace happens, so no compile event —
+                # the cache-hit counter carries the observability and
+                # the zero-fresh-trace assertion holds by construction
+                step_fn = cached
+                self._seen_sigs.add(sig)
         fresh = sig not in self._seen_sigs and len(self._seen_sigs) < 4096
         if fresh:
             import time as _t
 
             self._seen_sigs.add(sig)
             t0 = _t.perf_counter()
-        loss, self.train_params, self.rest_params, self.opt_state = self._step(
-            self.train_params, self.rest_params, self.opt_state, rng, x, y)
+        if step_fn is self._step:
+            loss, self.train_params, self.rest_params, self.opt_state = \
+                step_fn(self.train_params, self.rest_params,
+                        self.opt_state, rng, x, y)
+        else:
+            # cached executable: plain-dict calling convention (see
+            # _plain_tree); OrderedDict param maps keep their key-based
+            # meaning either way
+            loss, self.train_params, self.rest_params, self.opt_state = \
+                step_fn(self._plain_tree(self.train_params),
+                        self._plain_tree(self.rest_params),
+                        self._plain_tree(self.opt_state), rng, x, y)
         self.step_count += 1
         if fresh:
             from .. import telemetry as _telemetry
@@ -456,6 +567,14 @@ class TrainStep:
                 "train_step", type(self._net).__name__,
                 _t.perf_counter() - t0,
                 "new_step" if len(self._seen_sigs) == 1 else "new_shape")
+            pending = self._cc_pending.pop(sig, None)
+            if pending is not None:
+                # cold path: persist the executable so the NEXT process
+                # with this signature starts warm (the export re-traces
+                # once — still the cold path, and our tracer already
+                # recorded this signature's compile above)
+                key, avals = pending
+                self._cc.store_executable(key, self._step, *avals)
         return loss
 
     def run(self, batches, steps=None, prefetch=None):

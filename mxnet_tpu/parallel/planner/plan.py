@@ -279,6 +279,22 @@ class ShardingPlan:
         :func:`bucketing.shard_layout`)."""
         return _bucketing.shard_layout(size, self.zero_shards)
 
+    def transfer_plan_to(self, tgt_plan, signature=None,
+                         zero_buckets=()):
+        """The slice-move schedule from THIS plan's layout to
+        ``tgt_plan``'s — the elastic-resize entry point
+        (:func:`~mxnet_tpu.parallel.resharding.compute_transfer_plan`;
+        pure and digest-stable like the plans themselves).  Defaults to
+        this plan's own parameter signature.  MXT080 applies to the
+        result: apply it or explicitly ``discard()`` it, at uniform
+        SPMD level."""
+        from .. import resharding as _resharding
+
+        return _resharding.compute_transfer_plan(
+            self, tgt_plan,
+            self.signature if signature is None else signature,
+            zero_buckets=zero_buckets)
+
     # -- identity / serialization ------------------------------------------
     def to_json(self):
         return json.dumps({

@@ -405,6 +405,63 @@ class ZeroBucketEngine:
             self._carry[key] = tuple(
                 full[off:off + size].reshape(shape) for full in fulls)
 
+    def reshard(self, plan, budget_bytes=None):
+        """Live plan-to-plan resharding of every resident sharded-state
+        bucket: momentum/Adam moments move from the old plan's mesh to
+        ``plan``'s through the :mod:`~mxnet_tpu.parallel.resharding`
+        slice-move schedule (arXiv:2112.01075) — the in-flight
+        alternative to the retire → host-harvest → re-assemble round
+        trip (and, one level up, to the checkpoint disk round trip).
+        State identity (generation keys, member layout, true sizes) is
+        unchanged; only the flat padded leaves re-shard, so subsequent
+        ``step_bucket`` calls under the new plan continue the exact
+        trajectory a checkpoint restore would produce.
+
+        Never tears state: the transfer builds NEW leaves and the swap
+        happens only after the whole transfer succeeded (a
+        ``resharding.transfer`` fault costs one supervised retry)."""
+        from . import resharding as _resharding
+
+        old_dp = self.dp
+        old_plan, old_mesh = self._plan, self._mesh
+        # the new dp derives from the plan — probe it, but COMMIT
+        # nothing until the transfer succeeded (never-torn contract)
+        self._plan = plan
+        self._mesh = None
+        new_dp = self.dp
+        if not self._state:
+            self._jits = {}
+            self._record_hbm()
+            return self
+        arrays, buffers, layout = {}, [], []
+        for sk, entry in self._state.items():
+            label = self._shard_label(sk)
+            dtype = entry["dtype"]
+            for i, leaf in enumerate(entry["leaves"]):
+                name = f"zero:{label}.s{i}"
+                arrays[name] = leaf
+                buffers.append((name, entry["size"], dtype))
+            layout.append((sk, label, len(entry["leaves"])))
+        tplan = _resharding.compute_flat_transfer_plan(buffers, old_dp,
+                                                      new_dp)
+        try:
+            moved = _resharding.apply_transfer(tplan, arrays,
+                                               budget_bytes=budget_bytes)
+        except BaseException:
+            # roll the layout metadata back: the old leaves were never
+            # touched, so the engine keeps stepping under the old plan
+            # (or the caller falls back to the checkpoint path)
+            self._plan, self._mesh = old_plan, old_mesh
+            raise
+        for sk, label, n in layout:
+            self._state[sk]["leaves"] = tuple(
+                moved[f"zero:{label}.s{i}"] for i in range(n))
+        # jitted step bodies bake the old mesh/shard size into their
+        # shard_map: they can never be reused under the new plan
+        self._jits = {}
+        self._record_hbm()
+        return self
+
     def retire(self, generation):
         """A replan retired ``generation``'s bucket compositions for
         good: harvest its shards to per-parameter pieces so momentum

@@ -33,13 +33,16 @@ with ``lifecycle.EXIT_PREEMPTED``.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from collections import deque
 
 import numpy as _np
 
+from .. import compile_cache as _ccache
 from .. import env as _env
+from .. import fault as _fault
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..ndarray import dispatch_cache as _dc
@@ -48,6 +51,8 @@ from .scheduler import (AdmissionQueue, DeadlineExceededError, Request,
                         bucket_for, parse_buckets)
 
 __all__ = ["ServingEngine", "serve"]
+
+_LOGGER = logging.getLogger(__name__)
 
 
 # -- metric families (registered once; recording is always-on) -------------
@@ -83,6 +88,14 @@ _G_PAGES = _telemetry.gauge(
 _G_TOKS_S = _telemetry.gauge(
     "mxnet_serving_tokens_per_s",
     "generated tokens/s over the trailing window")
+_H_JOIN = _telemetry.histogram(
+    "mxnet_serving_join_to_first_token_seconds",
+    "replica handoff: wall time from joining (params donated by a "
+    "running engine) to this replica's first generated token")
+_C_STEP_FAIL = _telemetry.counter(
+    "mxnet_serving_step_failures_total",
+    "engine-loop steps that raised and were absorbed (incl. injected "
+    "serving.decode_step faults) — the loop retries, state untorn")
 
 
 class _Seq:
@@ -112,7 +125,8 @@ class ServingEngine:
 
     def __init__(self, net, *, batch_buckets=None, prefill_buckets=None,
                  kv_pages=None, page_size=None, queue_bound=None,
-                 max_batch=None, deadline_ms=None, name=None, plan=None):
+                 max_batch=None, deadline_ms=None, name=None, plan=None,
+                 params_from=None, compile_cache=None):
         from ..gluon.model_zoo.language.llama import (LlamaForCausalLM,
                                                       serving_params)
 
@@ -125,7 +139,11 @@ class ServingEngine:
                              "FFNs yet (prefill/decode_apply contract)")
         self._cfg = cfg
         self._name = name or "llama"
-        self._params = dict(serving_params(net))
+        # replica handoff skips this entirely: the donated params below
+        # ARE the weights, and the join-to-first-token path must not
+        # pay a second materialization from the net
+        self._params = {} if params_from is not None else \
+            dict(serving_params(net))
         # tensor-parallel serving (ROADMAP serving follow-on (a)): a
         # ShardingPlan places the frozen params once at construction and
         # every prefill/decode/sample executable AOT-compiles against
@@ -135,14 +153,33 @@ class ServingEngine:
         self._plan = plan
         self._serve_mesh = None
         self._rep_sharding = None
+        # warm-start compile cache (explicit > MXNET_COMPILE_CACHE_DIR
+        # session default > none): a warm engine start loads every AOT
+        # executable instead of tracing it — zero compile events
+        self._cc = _ccache.resolve(compile_cache)
+        # replica handoff (join_replica): a RUNNING donor engine hands
+        # its frozen params over through the live-resharding transfer
+        # (donor plan -> this plan) while it keeps serving — its param
+        # arrays are immutable, the transfer only reads them.  The
+        # join-to-first-token clock starts here.
+        self._join_t0 = None
+        if params_from is not None:
+            from ..parallel import resharding as _resharding
+
+            self._params = _resharding.transfer_params(
+                dict(params_from._params), src_plan=params_from._plan,
+                tgt_plan=plan)
+            self._join_t0 = time.monotonic()
         if plan is not None:
             import jax
 
             self._serve_mesh = plan.build_mesh()
             self._rep_sharding = plan.replicated(self._serve_mesh)
-            self._params = {
-                k: jax.device_put(v, plan.sharding(k, self._serve_mesh))
-                for k, v in self._params.items()}
+            if params_from is None:
+                self._params = {
+                    k: jax.device_put(v,
+                                      plan.sharding(k, self._serve_mesh))
+                    for k, v in self._params.items()}
         self._batch_buckets = list(batch_buckets) if batch_buckets else \
             parse_buckets(_env.serving_batch_buckets(), "batch bucket")
         self._prefill_buckets = list(prefill_buckets) if prefill_buckets \
@@ -381,26 +418,50 @@ class ServingEngine:
         # serving's logits gather before sampling
         jit_kw = {} if self._plan is None else \
             {"out_shardings": self._rep_sharding}
+        # warm-start path: a persisted executable for this exact
+        # signature (avals + plan digest + jax fingerprint) skips the
+        # trace AND the XLA compile — no compile event is recorded
+        # because no trace happened (the cache-hit counter carries the
+        # observability; the PR 3 zero-fresh-trace assertions rely on
+        # exactly this)
+        ckey = None
+        if self._cc is not None:
+            # cfg fields ride the key: two configs with identical param
+            # shapes (rope_base, rms_eps, ...) compile DIFFERENT math
+            cfg_fp = tuple(sorted(
+                (k, repr(v)) for k, v in vars(self._cfg).items()))
+            ckey = self._cc.key(
+                f"serving:{self._name}:{phase}",
+                (repr(key), cfg_fp,
+                 _ccache.aval_signature(param_avals),
+                 _ccache.aval_signature(pool_aval)),
+                plan_digest=self._plan.digest()
+                if self._plan is not None else None)
+            cached = self._cc.load_executable(ckey)
+            if cached is not None:
+                with self._lock:
+                    self._exec[key] = cached
+                return cached
         if phase == "prefill":
-            body = self._prefill_body(dims["L"], dims["P"])
-            lowered = jax.jit(body, donate_argnums=(1, 2),
-                              **jit_kw).lower(
-                param_avals, pool_aval, pool_aval, *dyn)
+            jit_fn = jax.jit(self._prefill_body(dims["L"], dims["P"]),
+                             donate_argnums=(1, 2), **jit_kw)
+            aot_args = (param_avals, pool_aval, pool_aval) + tuple(dyn)
         elif phase == "decode":
-            body = self._decode_body(dims["B"], dims["P"])
-            lowered = jax.jit(body, donate_argnums=(1, 2),
-                              **jit_kw).lower(
-                param_avals, pool_aval, pool_aval, *dyn)
+            jit_fn = jax.jit(self._decode_body(dims["B"], dims["P"]),
+                             donate_argnums=(1, 2), **jit_kw)
+            aot_args = (param_avals, pool_aval, pool_aval) + tuple(dyn)
         else:
-            lowered = jax.jit(self._sample_body(dims["B"]),
-                              **jit_kw).lower(*dyn)
-        compiled = lowered.compile()
+            jit_fn = jax.jit(self._sample_body(dims["B"]), **jit_kw)
+            aot_args = tuple(dyn)
+        compiled = jit_fn.lower(*aot_args).compile()
         with self._lock:
             self._exec[key] = compiled
         label = ":".join([self._name, phase] +
                          [f"{k}{v}" for k, v in sorted(dims.items())])
         _telemetry.compile_event("serving", label,
                                  time.perf_counter() - t0, cause)
+        if ckey is not None:
+            self._cc.store_executable(ckey, jit_fn, *aot_args)
         return compiled
 
     def _aot_warmup(self):
@@ -433,6 +494,20 @@ class ServingEngine:
             compiled = self._aot_compile(phase, "steady_state_miss",
                                          **dims)
         return compiled
+
+    # -- replica handoff ---------------------------------------------------
+    @classmethod
+    def join_replica(cls, net, donor, **kw):
+        """Replica scale-out handoff: build a new engine whose frozen
+        params are DONATED by a running ``donor`` engine through the
+        live-resharding transfer (donor plan → this engine's ``plan``
+        kw, replicated when absent) instead of re-read from the net or
+        loaded from disk.  The donor keeps serving throughout — its
+        param arrays are immutable and the transfer only reads them.
+        The join-to-first-token clock
+        (``mxnet_serving_join_to_first_token_seconds``) starts at the
+        handoff and stops at this replica's first generated token."""
+        return cls(net, params_from=donor, **kw)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -541,6 +616,7 @@ class ServingEngine:
     def _run_loop(self):
         from .. import lifecycle
 
+        consec_fail = 0
         while True:
             if lifecycle.stop_requested():
                 self._stop_evt.set()
@@ -549,7 +625,35 @@ class ServingEngine:
                     self._abort_active()
                 if not self._active:
                     break
-            did_work = self._step()
+            try:
+                did_work = self._step()
+                consec_fail = 0
+            except Exception as e:
+                # an engine step must never kill the loop thread: the
+                # chaos seams (serving.decode_step) raise BEFORE any
+                # KV/sequence mutation, so the step simply retries —
+                # and a real bug becomes a counted, logged failure
+                # instead of a silently dead server.  Bounded, not
+                # blind: each failure backs off (no hot spin), the log
+                # is rate-limited, and a PERSISTENT failure resolves
+                # the wedged in-flight work with the error instead of
+                # hanging its callers forever
+                _C_STEP_FAIL.inc()
+                consec_fail += 1
+                if consec_fail <= 3 or consec_fail % 10 == 0:
+                    _LOGGER.warning(
+                        "serving engine step failed (%r); retrying "
+                        "(%d consecutive)", e, consec_fail)
+                if consec_fail >= self._MAX_CONSEC_STEP_FAILURES:
+                    _LOGGER.critical(
+                        "serving engine step failed %d times in a row "
+                        "(%r); failing the wedged in-flight work so "
+                        "callers unblock", consec_fail, e)
+                    self._fail_active(e)
+                    consec_fail = 0
+                self._stop_evt.wait(0.05)
+                did_work = False
+                continue
             if not did_work and not self._stop_evt.is_set():
                 self._queue.wait_nonempty(0.02)
         # flag BEFORE the final drain: a submit() that races past the
@@ -617,6 +721,16 @@ class ServingEngine:
         requeued)."""
         import jax.numpy as jnp
 
+        try:
+            # chaos seam: a tripped admission loses nothing — the
+            # request returns to the queue FRONT and the next loop
+            # iteration retries it
+            _fault.check("serving.admit")
+        except Exception as e:
+            _LOGGER.warning("serving.admit fault for request %s (%r); "
+                            "requeued", req.id, e)
+            self._queue.requeue(req)
+            return False
         if req.expired():
             req.resolve(DeadlineExceededError(
                 f"request {req.id} expired before prefill"))
@@ -663,6 +777,11 @@ class ServingEngine:
         if req.first_token_t is None:
             req.first_token_t = time.monotonic()
             _H_TTFT.observe(req.first_token_t - req.submitted)
+            if self._join_t0 is not None:
+                # replica handoff acceptance metric: donated-params
+                # join -> this replica's FIRST served token
+                _H_JOIN.observe(req.first_token_t - self._join_t0)
+                self._join_t0 = None
         req.tokens.append(tok)
         _C_TOKENS.labels(kind="generated").inc()
         if self._is_finished(req, tok, L):
@@ -698,6 +817,10 @@ class ServingEngine:
     def _decode_step(self):
         import jax.numpy as jnp
 
+        # chaos seam, checked BEFORE any KV/table/sequence mutation: a
+        # trip unwinds to the loop guard with zero torn state and the
+        # step retries next iteration
+        _fault.check("serving.decode_step")
         # grow tables first; eviction inside can shrink the active set
         for seq in list(self._active):
             if seq not in self._active:
@@ -796,6 +919,25 @@ class ServingEngine:
         # eos_id) vs "length" (max_new_tokens or the context/pool cap —
         # the signal an operator watches for silent truncation)
         _C_REQS.labels(outcome=reason).inc()
+
+    # consecutive step failures before the loop stops retrying and
+    # fails the in-flight work (at the 0.05s per-failure backoff this
+    # is ~2.5s of a persistently broken step — far beyond any armed
+    # chaos burst, far short of a caller's request timeout)
+    _MAX_CONSEC_STEP_FAILURES = 50
+
+    def _fail_active(self, error):
+        """Resolve every in-flight sequence with ``error`` (persistent
+        step failure): their pages free, their callers unblock with the
+        real cause, and the loop keeps serving whatever work does not
+        hit the broken path."""
+        for seq in list(self._active):
+            self._kv.free(seq.req.id)
+            seq.req.resolve(MXNetError(
+                f"request {seq.req.id} failed: serving engine step "
+                f"persistently failing ({error!r})"))
+            _C_REQS.labels(outcome="error").inc()
+        self._active = []
 
     def _abort_active(self):
         for seq in list(self._active):

