@@ -1085,6 +1085,164 @@ def bench_serving():
     }
 
 
+def bench_observability():
+    """Runtime introspection plane (ISSUE 14): prove the instrumentation
+    is free where it must be, and right where it measures.
+
+    - **eager A/B**: the eager dispatch path gains ZERO work from the
+      introspection plane; µs/op with request tracing + aggregation
+      ticking enabled vs everything off must be within noise.
+    - **serving A/B**: engine tokens/s with per-request tracing on vs
+      ``MXNET_TRACE_REQUESTS=0`` — host-side stamps only, within noise.
+    - **online-vs-offline MFU pin** (llama proxy): the online gauge and
+      an offline ``steps × flops / (wall × peak × devices)`` computed
+      from the SAME cost_analysis FLOPs source must agree tightly (the
+      only divergence is window-edge timing).
+    """
+    import os
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import introspection, nd, serving, telemetry
+
+    out = {}
+    # -- eager A/B ---------------------------------------------------------
+    # process-level warmup first (jit-cache fill, jax internals), then
+    # best-of-2 per arm — the instrumentation adds literally zero code
+    # to this path, so any residual delta IS scheduler noise and must
+    # not flip the verdict
+    bench_eager_op_overhead(iters=60, warmup=20)
+
+    def eager_us(trace_env):
+        prev = os.environ.get("MXNET_TRACE_REQUESTS")
+        os.environ["MXNET_TRACE_REQUESTS"] = trace_env
+        try:
+            return min(bench_eager_op_overhead(
+                iters=150, warmup=20)["us_per_op_jit"]
+                for _ in range(2))
+        finally:
+            if prev is None:
+                os.environ.pop("MXNET_TRACE_REQUESTS", None)
+            else:
+                os.environ["MXNET_TRACE_REQUESTS"] = prev
+
+    us_on = eager_us("1")
+    us_off = eager_us("0")
+    ratio = us_on / us_off if us_off else 1.0
+    out["eager_overhead"] = {
+        "us_per_op_introspection_on": us_on,
+        "us_per_op_introspection_off": us_off,
+        "ratio": round(ratio, 3),
+        "within_noise": bool(0.8 <= ratio <= 1.25),
+    }
+
+    # -- serving tokens/s A/B ---------------------------------------------
+    from mxnet_tpu.gluon.model_zoo.language.llama import llama_tiny
+
+    def serving_tokens_per_s(trace_on):
+        net = llama_tiny()
+        net.initialize()
+        net(nd.zeros((1, 8), dtype="int32"))
+        eng = serving.ServingEngine(
+            net, batch_buckets=[1, 2, 4], prefill_buckets=[8, 16],
+            kv_pages=64, page_size=8, max_batch=4,
+            trace_requests=trace_on)
+        eng.start()
+        R = np.random.RandomState(0)
+        # warm every bucket, then measure a fixed closed-loop burst
+        for n in (3, 8, 11, 16):
+            eng.submit(R.randint(1, 512, (n,)).astype("int32"),
+                       max_new_tokens=2).result(timeout=300)
+        t0 = time.perf_counter()
+        reqs = [eng.submit(R.randint(1, 512, (8,)).astype("int32"),
+                           max_new_tokens=8) for _ in range(12)]
+        for r in reqs:
+            r.result(timeout=300)
+        dt = time.perf_counter() - t0
+        eng.close()
+        return 12 * 8 / dt
+
+    # first engine of the process pays one-time warmup (jax internals,
+    # libtpu init) regardless of the arm — throw it away, then
+    # ALTERNATE the arms (slow drift hits both equally) and take the
+    # best of three per arm so scheduler noise cannot flip the verdict
+    serving_tokens_per_s(False)
+    on_runs, off_runs = [], []
+    for _ in range(3):
+        on_runs.append(serving_tokens_per_s(True))
+        off_runs.append(serving_tokens_per_s(False))
+    tps_on, tps_off = max(on_runs), max(off_runs)
+    sratio = tps_on / tps_off if tps_off else 1.0
+    out["serving_overhead"] = {
+        "tokens_per_s_trace_on": round(tps_on, 1),
+        "tokens_per_s_trace_off": round(tps_off, 1),
+        "ratio": round(sratio, 3),
+        "within_noise": bool(sratio >= 0.8),
+    }
+
+    # -- online-vs-offline MFU pin (same FLOPs source) ---------------------
+    import jax
+
+    from mxnet_tpu.gluon.model_zoo.language import llama
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    cfg = dict(vocab_size=512, hidden_size=128, num_layers=2,
+               num_heads=4, num_kv_heads=2, intermediate_size=256,
+               max_seq_len=256)
+    net = llama.LlamaForCausalLM(llama.LlamaConfig(**cfg))
+    net.initialize(ctx=mx.current_context())
+    net(mx.nd.zeros((1, 64), dtype="int32"))
+
+    def loss_fn(logits, labels):
+        import jax.numpy as jnp
+
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[..., None], axis=-1)
+
+    step = TrainStep(net, loss_fn, optimizer="adam",
+                     optimizer_params={"learning_rate": 3e-4})
+    ids = np.random.RandomState(1).randint(
+        0, cfg["vocab_size"], (2, 64)).astype("int32")
+    labels = np.random.RandomState(2).randint(
+        0, cfg["vocab_size"], (2, 64)).astype("int32")
+    peak = introspection.device_peak_flops() or 1e12
+    prev_peak = os.environ.get("MXNET_DEVICE_PEAK_FLOPS")
+    os.environ["MXNET_DEVICE_PEAK_FLOPS"] = repr(peak)
+    try:
+        np.asarray(step(ids, labels))        # warmup: trace + compile
+        introspection.reset()
+        iters = 8
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            np.asarray(step(ids, labels))    # per-step sync loop
+        wall = time.perf_counter() - t0
+        online = introspection.utilization()
+        # MRU head: multi-axis meshes can hold >1 AOT variant per sig
+        _, flops_per_step = step._compiled[
+            next(iter(step._compiled))][0]
+        ndev = max(1, jax.device_count())
+        offline = (iters * (flops_per_step or 0)
+                   / (wall * peak * ndev)) if flops_per_step else None
+    finally:
+        if prev_peak is None:
+            os.environ.pop("MXNET_DEVICE_PEAK_FLOPS", None)
+        else:
+            os.environ["MXNET_DEVICE_PEAK_FLOPS"] = prev_peak
+    mfu_ratio = (online / offline) if (online and offline) else None
+    out["mfu_pin"] = {
+        "flops_per_step": flops_per_step,
+        "online_mfu": round(online, 6) if online else None,
+        "offline_mfu": round(offline, 6) if offline else None,
+        "ratio": round(mfu_ratio, 3) if mfu_ratio else None,
+        # same FLOPs source: only window-edge timing can diverge
+        "within_tolerance": bool(mfu_ratio and
+                                 0.75 <= mfu_ratio <= 1.35),
+    }
+    out["goodput"] = telemetry.goodput_summary()
+    return out
+
+
 def _probe_backend(timeout=90, retries=2):
     """Initialize the backend in a SUBPROCESS first, with a timeout.
 
@@ -1204,6 +1362,14 @@ def main():
         extra["elastic"] = bench_elastic()
     except Exception as e:
         extra["elastic"] = {"error": repr(e)[:200]}
+    try:
+        # runtime introspection plane (ISSUE 14): A/B instrumentation
+        # overhead (eager µs/op + serving tokens/s, tracing on vs off)
+        # and the online-vs-offline MFU pin on the llama proxy (same
+        # cost_analysis FLOPs source => tight tolerance)
+        extra["observability"] = bench_observability()
+    except Exception as e:
+        extra["observability"] = {"error": repr(e)[:200]}
     try:
         # BASELINE binding metric: allreduce bandwidth (tools/bandwidth_
         # measure.py ≙ reference tools/bandwidth/measure.py).  The bus

@@ -1,7 +1,19 @@
-"""Telemetry-lane smoke (ISSUE 3): a tiny train loop with telemetry +
-profiler on must produce a parseable Prometheus rendering carrying the
-core metric families, a snapshot whose per-step phase durations sum to
-the step wall time, and at least one compile event with a cause.
+"""Telemetry-lane smoke (ISSUE 3 + the ISSUE 14 introspection plane): a
+tiny train loop with telemetry + profiler on must produce a parseable
+Prometheus rendering carrying the core metric families, a snapshot whose
+per-step phase durations sum to the step wall time, and at least one
+compile event with a cause.  The introspection-plane extensions:
+
+- the online-MFU/goodput families are live on the endpoint (a TrainStep
+  run under a peak override feeds ``mxnet_model_flops_utilization`` +
+  ``mxnet_executable_flops_total``; the step loop feeds the goodput
+  ledger);
+- ``/v1/requests`` round-trips per-request span trees under a 4-client
+  HTTP load with the SLOWEST request provably retained (tail-based
+  retention);
+- a 2-process aggregation run (real children, rank-stamped) produces
+  rank-labeled series and the ``mxnet_rank_step_skew_seconds`` skew
+  histogram through the file-based gather — no device collectives.
 
 Run by ci/runtest.sh telemetry as:
 
@@ -16,8 +28,10 @@ in the registry) fails CI.
 import json
 import os
 import re
+import subprocess
 import sys
 import tempfile
+import threading
 import urllib.request
 
 # the script lives in ci/; the repo root is the import root
@@ -48,6 +62,10 @@ CORE_FAMILIES = (
     "mxnet_compile_events_total",           # compile tracer
     "mxnet_dataloader_batch_wait_seconds",  # data path
     "mxnet_kvstore_push_bytes_total",       # kvstore traffic
+    "mxnet_goodput_seconds_total",          # ISSUE 14: goodput ledger
+    "mxnet_goodput_ratio",
+    "mxnet_executable_flops_total",         # ISSUE 14: online MFU
+    "mxnet_model_flops_utilization",
 )
 
 
@@ -97,8 +115,140 @@ def train_loop(steps=6):
     return done
 
 
+def train_step_mfu(steps=3):
+    """Feed the online-MFU gauge through the public TrainStep surface
+    (cost_analysis FLOPs under a peak override)."""
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    net = nn.Dense(2)
+    net.initialize()
+    net(nd.ones((1, 3)))
+
+    def loss_fn(out, y):
+        import jax.numpy as jnp
+
+        return jnp.square(out - y).mean()
+
+    step = TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.01})
+    for _ in range(steps):
+        np.asarray(step(np.ones((4, 3), "f"), np.zeros((4, 2), "f")))
+
+
+def serving_request_traces(port):
+    """4 HTTP clients against the live engine, then /v1/requests: the
+    JSON round-trips and the SLOWEST request is retained (tail-based
+    retention contract)."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.gluon.model_zoo.language.llama import llama_tiny
+
+    net = llama_tiny()
+    net.initialize()
+    net(nd.zeros((1, 8), dtype="int32"))
+    eng = serving.ServingEngine(net, batch_buckets=[1, 2, 4],
+                                prefill_buckets=[8, 16], kv_pages=64,
+                                page_size=8, max_batch=4)
+    eng.start()
+    eng.mount_http()
+    results, lock = [], threading.Lock()
+
+    def client(k):
+        R = np.random.RandomState(100 + k)
+        for i in range(3):
+            body = json.dumps({
+                "prompt": R.randint(1, 512,
+                                    (int(R.randint(2, 16)),)).tolist(),
+                # one long straggler: it MUST survive retention
+                "max_new_tokens": 24 if (k, i) == (0, 0) else 4,
+            }).encode()
+            r = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=300)
+            out = json.loads(r.read())
+            with lock:
+                results.append(out)
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 12, len(results)
+    slowest = max(results, key=lambda r: r["latency_s"])
+    doc = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/v1/requests", timeout=30).read())
+    assert doc["enabled"] and doc["traced_requests"] >= 12, doc
+    by_id = {t["trace_id"]: t for t in doc["requests"]}
+    assert slowest["request_id"] in by_id, \
+        (slowest["request_id"], sorted(by_id))
+    tr = by_id[slowest["request_id"]]
+    assert "slowest" in tr["retained_by"], tr["retained_by"]
+    names = [c["name"] for c in tr["tree"]["children"]]
+    assert names[0] == "queue_wait" and "prefill" in names and \
+        "decode_step" in names, names
+    eng.close()
+    return len(doc["requests"])
+
+
+_AGG_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, __ROOT__)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry, telemetry_agg
+
+rank = int(sys.argv[1])
+telemetry_agg.configure(directory=sys.argv[2], every=1, rank=rank,
+                        world=2)
+for step in range(3):
+    telemetry.step_begin(step)
+    with telemetry.phase("data"):
+        time.sleep(0.002 + 0.02 * rank)   # rank 1 is the straggler
+    with telemetry.phase("forward_backward"):
+        time.sleep(0.004)
+    telemetry.step_end()                  # ticks the aggregator
+if rank == 0:
+    # re-merge at exit so rank 0's file reflects the final state too
+    doc = telemetry_agg.merge_dir(sys.argv[2])
+    print(json.dumps({"ranks": doc["ranks"]}))
+"""
+
+
+def two_process_aggregation():
+    """Two real rank-stamped children publish through the file gather;
+    the parent (= rank 0's view, re-merged offline exactly like
+    tools/teldump agg) asserts rank-labeled series + skew presence."""
+    from mxnet_tpu import telemetry_agg
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    agg_dir = tempfile.mkdtemp(prefix="telemetry_agg_smoke_")
+    script = _AGG_CHILD.replace("__ROOT__", repr(root))
+    # rank 1 (the straggler) first so rank 0's merge sees both files
+    for rank in (1, 0):
+        out = subprocess.run(
+            [sys.executable, "-c", script, str(rank), agg_dir],
+            capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, (rank, out.stderr[-2000:])
+        if rank == 0:
+            assert json.loads(
+                out.stdout.strip().splitlines()[-1])["ranks"] == [0, 1]
+    doc = telemetry_agg.merge_dir(agg_dir)   # parent-side re-merge
+    assert doc["ranks"] == [0, 1], doc["ranks"]
+    steps = doc["metrics"]["mxnet_steps_total"]["samples"]
+    assert [s["labels"]["rank"] for s in steps] == ["0", "1"], steps
+    assert doc["skew"]["step"] is not None
+    assert doc["skew"]["phases"]["data"] > 0.01, doc["skew"]
+    hist = telemetry.snapshot()["metrics"][
+        "mxnet_rank_step_skew_seconds"]
+    assert any(s["count"] for s in hist["samples"]), hist
+    return doc["skew"]["phases"]["data"]
+
+
 def main():
     telemetry.reset()
+    os.environ.setdefault("MXNET_DEVICE_PEAK_FLOPS", "1e12")
     trace = os.path.join(tempfile.mkdtemp(prefix="telemetry_smoke_"),
                          "profile.json")
     profiler.set_config(profile_imperative=True, filename=trace,
@@ -106,22 +256,29 @@ def main():
     profiler.start()
     try:
         steps = train_loop()
+        train_step_mfu()
     finally:
         profiler.stop()
     assert steps == 6, steps
 
     # 1) Prometheus rendering parses; core families present (also via the
-    #    live HTTP endpoint, scraped the way Prometheus would)
+    #    live HTTP endpoint, scraped the way Prometheus would) — and the
+    #    serving trace + 2-process aggregation rounds run against the
+    #    same live endpoint before it is scraped
     srv = telemetry.start_http_server(port=0)
     try:
         port = srv.server_address[1]
+        kept = serving_request_traces(port)
+        skew = two_process_aggregation()
         body = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
     finally:
         telemetry.stop_http_server()
     names = parse_prometheus(body)
-    missing = [f for f in CORE_FAMILIES
-               if not any(n.startswith(f) for n in names)]
+    missing = [f for f in CORE_FAMILIES + (
+        "mxnet_serving_tokens_total", "mxnet_tokens_per_s_per_chip",
+        "mxnet_rank_step_skew_seconds")
+        if not any(n.startswith(f) for n in names)]
     assert not missing, f"families missing from /metrics: {missing}"
 
     # 2) snapshot: per-step phase durations sum to ~step wall time
@@ -149,10 +306,21 @@ def main():
     assert "step_phase" in cats, cats
     assert "telemetry" in data["otherData"]
 
+    # 4) introspection plane: the goodput ledger classified the loop as
+    #    productive and the MFU gauge is live under the peak override
+    good = snap["goodput"]
+    assert good["buckets"].get("productive", 0) > 0, good
+    assert good["productive_ratio"] and 0 < good["productive_ratio"] <= 1
+    util = snap["metrics"]["mxnet_model_flops_utilization"][
+        "samples"][0]["value"]
+    assert util > 0, util
+
     phases = sorted(snap["step_phase_totals"])
     print(f"telemetry_smoke OK: steps={len(snap['steps'])} "
           f"phases={phases} compile_events={len(evs)} "
-          f"kinds={sorted(kinds)} prom_families={len(names)}")
+          f"kinds={sorted(kinds)} prom_families={len(names)} "
+          f"traces_kept={kept} data_skew_s={skew:.4f} "
+          f"mfu={util:.5f} goodput={good['productive_ratio']:.3f}")
 
 
 if __name__ == "__main__":

@@ -387,7 +387,12 @@ class CheckpointManager:
             # dangling frame mis-attributes the rest of the step
             with telemetry.phase("checkpoint"):
                 self._write_step(step, write_payloads, extra, primary)
-            _SAVE_HIST.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            _SAVE_HIST.observe(dt)
+            # goodput: a synchronous save blocks training for its full
+            # duration (the step timeline excludes its in-step
+            # checkpoint phase from "productive" for the same reason)
+            telemetry.goodput_note("checkpoint", dt)
             _SAVES_TOTAL.inc()
             return final
         # async: snapshot device→host NOW (host copies — the step loop
@@ -415,7 +420,12 @@ class CheckpointManager:
                 # path's finally in _write_step) — peers are already
                 # blocked in it
                 self._barrier()
-        _SNAPSHOT_HIST.observe(time.perf_counter() - t0)
+        dt_snap = time.perf_counter() - t0
+        _SNAPSHOT_HIST.observe(dt_snap)
+        # goodput: an ASYNC save only blocks for the device->host
+        # snapshot — the background write overlaps training and is
+        # deliberately NOT charged (that overlap is the feature)
+        telemetry.goodput_note("checkpoint", dt_snap)
         if not primary:
             return final  # nothing to write; the snapshot barrier is done
 
@@ -672,9 +682,21 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
     last_ckpt_step = progress() or 0
     last_live_step = None
     live_start = None
+    fail_t = None          # goodput: failure -> next attempt downtime
+    reshard_dt = 0.0       # resharder time inside that window (charged
+    #                        to the reshard bucket by apply_transfer)
     while True:
         start = live_start if live_start is not None else progress() or 0
         live_start = None
+        if fail_t is not None:
+            # restart downtime: everything between the failure and this
+            # re-attempt (join, progress probe, backoff sleep) except
+            # the live-reshard transfer, which the resharding seam
+            # already charged to its own bucket
+            telemetry.goodput_note(
+                "restart",
+                max(0.0, time.perf_counter() - fail_t - reshard_dt))
+            fail_t, reshard_dt = None, 0.0
         try:
             result = train_fn(start, manager)
             # a final async save may still be staging: join before the
@@ -708,6 +730,7 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
         except Exception as e:
             if should_retry is not None and not should_retry(e):
                 raise
+            fail_t = time.perf_counter()
             # a background checkpoint write may still be in flight from
             # before the failure: let it finish (it may publish the step
             # that resets the budget) before judging progress — a FAILED
@@ -728,12 +751,26 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
                 # "stuck" at a step it long passed.
                 from .parallel import resharding as _resharding
 
+                t_rs = time.perf_counter()
+                rs_before = telemetry.goodput_summary()["buckets"].get(
+                    "reshard", 0.0)
                 try:
                     live_start = resharder(e)
                 except Exception as re:
                     live_start = None
                     log.warning("live resharder failed (%r); falling "
                                 "back to checkpoint restore", re)
+                reshard_dt = time.perf_counter() - t_rs
+                # the whole resharder call is reshard-bucket time, but
+                # only its apply_transfer portion self-charges at the
+                # seam — top the bucket up with the uncovered remainder
+                # (plan building, agreement, a raise BEFORE the
+                # transfer) so the time subtracted from the restart
+                # bucket below never vanishes from the ledger
+                covered = telemetry.goodput_summary()["buckets"].get(
+                    "reshard", 0.0) - rs_before
+                telemetry.goodput_note("reshard",
+                                       max(0.0, reshard_dt - covered))
                 if live_start is not None:
                     _resharding.record_live_reshard()
                     log.info("live reshard accepted: resuming from "

@@ -149,14 +149,17 @@ class CompileCache:
         return os.path.join(self.directory, f"{key}.exe")
 
     # -- raw entries -------------------------------------------------------
-    def get_bytes(self, key):
-        """The verified payload for ``key``, or None (miss).  Every
-        failure mode — missing file, torn header, truncated payload,
-        checksum mismatch, fingerprint drift — is a SILENT miss.
-        Counts misses/corruption only; a HIT is counted by
-        :meth:`load_executable` once an executable is actually served —
-        a verified blob that later fails to deserialize must end up in
-        the miss column, not the hit column."""
+    def get_entry(self, key):
+        """``(payload, meta)`` for a verified entry, or ``(None, {})``
+        (miss).  ``meta`` is the caller-supplied sidecar from
+        :meth:`put_bytes` — e.g. the compile-time FLOP count a warm
+        load needs for online MFU accounting without re-deriving cost
+        analysis.  Every failure mode — missing file, torn header,
+        truncated payload, checksum mismatch, fingerprint drift — is a
+        SILENT miss.  Counts misses/corruption only; a HIT is counted
+        by :meth:`load_executable` once an executable is actually
+        served — a verified blob that later fails to deserialize must
+        end up in the miss column, not the hit column."""
         path = self._path(key)
         try:
             with open(path, "rb") as f:
@@ -164,7 +167,7 @@ class CompileCache:
                 payload = f.read()
         except (OSError, ValueError):
             _MISSES.inc()
-            return None
+            return None, {}
         try:
             ok = (header.get("fingerprint") == _jax_fingerprint()
                   and header.get("size") == len(payload)
@@ -178,8 +181,14 @@ class CompileCache:
             self.logger.warning(
                 "compile cache entry %s failed verification; treating "
                 "as a miss (it will be re-traced and overwritten)", path)
-            return None
-        return payload
+            return None, {}
+        meta = header.get("meta")
+        return payload, (meta if isinstance(meta, dict) else {})
+
+    def get_bytes(self, key):
+        """The verified payload for ``key``, or None (miss) — see
+        :meth:`get_entry` for the failure-mode contract."""
+        return self.get_entry(key)[0]
 
     def put_bytes(self, key, payload, meta=None):
         """Atomically publish ``payload`` under ``key`` (tmp + fsync +
@@ -213,6 +222,18 @@ class CompileCache:
         return True
 
     # -- executables -------------------------------------------------------
+    def load_executable_entry(self, key):
+        """``(callable, meta)`` — :meth:`load_executable` plus the
+        entry's meta sidecar (``{"flops": ...}`` when the storer
+        recorded its compile-time cost analysis, so a warm start keeps
+        the online MFU gauge fed without a fresh compile to ask).
+        ``(None, {})`` on any miss."""
+        blob, meta = self.get_entry(key)
+        if blob is None:
+            return None, {}
+        fn = self._deserialize(key, blob)
+        return fn, (meta if fn is not None else {})
+
     def load_executable(self, key):
         """Deserialize the cached executable for ``key`` into a
         callable (``jax.jit`` of the exported artifact's call — fast
@@ -223,6 +244,9 @@ class CompileCache:
         blob = self.get_bytes(key)
         if blob is None:
             return None
+        return self._deserialize(key, blob)
+
+    def _deserialize(self, key, blob):
         try:
             import jax
             from jax import export as _export
@@ -243,17 +267,19 @@ class CompileCache:
         _HITS.inc()
         return fn
 
-    def store_executable(self, key, jit_fn, *avals, **kw_avals):
+    def store_executable(self, key, jit_fn, *avals, meta=None, **kw_avals):
         """Serialize ``jit_fn`` lowered at ``avals`` and publish it
-        under ``key``.  The export re-traces the function once (cold
-        path, already paying a trace) — never raises: an unexportable
-        program (unsupported primitive, platform quirk) just leaves the
-        cache cold."""
+        under ``key``.  ``meta`` (JSON-able dict — e.g. the executable's
+        cost-analysis FLOPs) rides the entry header and comes back from
+        :meth:`load_executable_entry`.  The export re-traces the
+        function once (cold path, already paying a trace) — never
+        raises: an unexportable program (unsupported primitive,
+        platform quirk) just leaves the cache cold."""
         try:
             from jax import export as _export
 
             exported = _export.export(jit_fn)(*avals, **kw_avals)
-            return self.put_bytes(key, exported.serialize())
+            return self.put_bytes(key, exported.serialize(), meta=meta)
         except Exception as e:
             self.logger.warning(
                 "compile cache: could not export executable for key "

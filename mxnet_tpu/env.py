@@ -57,6 +57,23 @@ Wired vars (read at ``import mxnet_tpu``):
   (completed per-step phase records kept for snapshot(); default 256).
 - ``MXNET_TELEMETRY_COMPILE_EVENTS``: compile-event ring capacity
   (fresh jax.jit traces kept with elapsed + cause; default 512).
+- ``MXNET_TELEMETRY_AGG_EVERY``: cross-rank telemetry aggregation
+  stride — every N-th step-boundary tick each rank publishes its
+  snapshot to ``MXNET_TELEMETRY_AGG_DIR`` and rank 0 merges the peers'
+  into rank-labeled families + per-phase skew histograms (default 0 =
+  off; pure host-side file IO, never a device collective — see
+  :mod:`mxnet_tpu.telemetry_agg`).
+- ``MXNET_TELEMETRY_AGG_DIR``: the shared directory those per-rank
+  snapshot files live in (unset = aggregation off).
+- ``MXNET_TRACE_REQUESTS``: per-request serving span traces (queue wait
+  → prefill → per-decode-step → sample → finish; default 1 — see
+  :mod:`mxnet_tpu.serving.tracing` and the ``/v1/requests`` route).
+- ``MXNET_TRACE_KEEP_SLOWEST``: tail-based retention — the N slowest
+  completed request traces are always kept (default 16; error/evicted
+  traces are kept regardless).
+- ``MXNET_DEVICE_PEAK_FLOPS``: per-device peak FLOP/s override for the
+  online MFU gauge (default 0 = TPU device-kind table; unknown peak =
+  the gauge stays absent — see :mod:`mxnet_tpu.introspection`).
 - ``MXNET_PREFETCH_BUFFER``: device-prefetch queue depth for
   ``DataLoader(prefetch_to_device=...)`` / ``TrainStep.run`` (default 2;
   0 disables the background pipeline — see gluon/data/prefetcher.py).
@@ -413,6 +430,42 @@ def compile_cache_salt():
     return get_str("MXNET_COMPILE_CACHE_SALT", "") or ""
 
 
+def telemetry_agg_every():
+    """Cross-rank telemetry aggregation stride: publish/merge per-rank
+    snapshots every N-th step-boundary tick (MXNET_TELEMETRY_AGG_EVERY,
+    default 0 = aggregation off; mxnet_tpu/telemetry_agg.py)."""
+    return max(0, get_int("MXNET_TELEMETRY_AGG_EVERY", 0))
+
+
+def telemetry_agg_dir():
+    """Shared directory for the per-rank snapshot files the cross-rank
+    aggregator gathers (MXNET_TELEMETRY_AGG_DIR; required for
+    aggregation — unset leaves it off even with a stride set)."""
+    return get_str("MXNET_TELEMETRY_AGG_DIR")
+
+
+def trace_requests():
+    """Per-request serving trace recording (MXNET_TRACE_REQUESTS,
+    default 1; 0 disables span/event capture — the bench A/B knob;
+    serving/tracing.py)."""
+    return get_bool("MXNET_TRACE_REQUESTS", True)
+
+
+def trace_keep_slowest():
+    """Tail-based retention: how many of the SLOWEST completed request
+    traces are always kept alongside the recent ring and the
+    error/evicted set (MXNET_TRACE_KEEP_SLOWEST, default 16)."""
+    return max(1, get_int("MXNET_TRACE_KEEP_SLOWEST", 16))
+
+
+def device_peak_flops_override():
+    """Manual per-device peak FLOP/s for online MFU accounting
+    (MXNET_DEVICE_PEAK_FLOPS, default 0 = use the TPU device-kind
+    table; required on backends the table does not know — without a
+    peak the MFU gauge stays absent; mxnet_tpu/introspection.py)."""
+    return max(0.0, get_float("MXNET_DEVICE_PEAK_FLOPS", 0.0))
+
+
 def describe():
     """One line per known var: current value and what it maps to."""
     lines = []
@@ -449,6 +502,19 @@ def describe():
          "(default 256; mxnet_tpu.telemetry)"),
         ("MXNET_TELEMETRY_COMPILE_EVENTS", "compile-event ring capacity "
          "(default 512; mxnet_tpu.telemetry)"),
+        ("MXNET_TELEMETRY_AGG_EVERY", "cross-rank snapshot aggregation "
+         "stride in step-boundary ticks (default 0 = off; "
+         "mxnet_tpu/telemetry_agg.py)"),
+        ("MXNET_TELEMETRY_AGG_DIR", "shared directory for per-rank "
+         "snapshot files the aggregator merges (unset = aggregation "
+         "off)"),
+        ("MXNET_TRACE_REQUESTS", "per-request serving span traces "
+         "(default 1; 0 = no capture; serving/tracing.py)"),
+        ("MXNET_TRACE_KEEP_SLOWEST", "slowest-N request traces always "
+         "retained (tail-based retention; default 16)"),
+        ("MXNET_DEVICE_PEAK_FLOPS", "per-device peak FLOP/s override "
+         "for online MFU (default 0 = TPU device-kind table; "
+         "mxnet_tpu/introspection.py)"),
         ("MXNET_PREFETCH_BUFFER", "device-prefetch queue depth "
          "(default 2; 0 = no background pipeline; "
          "gluon/data/prefetcher.py)"),

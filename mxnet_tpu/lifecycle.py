@@ -190,6 +190,10 @@ def check_stop(sync=None):
     a delivered preemption signal, so the whole graceful-shutdown path
     is testable without a real SIGTERM."""
     telemetry.heartbeat()
+    # cross-rank telemetry aggregation rides this same uniform step
+    # boundary (host-side file IO only — never a collective, so it
+    # composes with the MXNET_STOP_SYNC_EVERY stride below freely)
+    telemetry._agg_tick()
     try:
         fault.check("lifecycle.sigterm")
     except Exception as e:
@@ -570,6 +574,11 @@ class Watchdog:
     def _fire(self, age, injected):
         self.stall_count += 1
         _STALLS_TOTAL.inc()
+        # goodput ledger: the heartbeat gap IS wall time the job lost
+        # to the stall (injected chaos fires charge nothing real — age
+        # there is just time since the last step boundary)
+        if injected is None:
+            telemetry.goodput_note("stall", age)
         cause = f"injected fault ({injected})" if injected is not None \
             else (f"no step heartbeat for {age:.1f}s "
                   f"(deadline {self.timeout_s:.1f}s)")

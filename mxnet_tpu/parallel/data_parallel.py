@@ -422,7 +422,19 @@ class TrainStep:
 
         self._cc = _ccache.resolve(compile_cache)
         self._cc_fns = {}        # batch sig -> cached callable | None
+        self._cc_meta = {}       # batch sig -> cache-entry meta (flops)
         self._cc_pending = {}    # batch sig -> (key, avals) to store
+        # per-signature AOT executables (lower().compile() on the cold
+        # path): the compiled object is what steady state dispatches,
+        # and its cost_analysis() FLOP count — captured ONCE here, at
+        # compile time — feeds the online MFU gauge with zero
+        # steady-state work (mxnet_tpu/introspection.py).  Each sig
+        # keeps a small MRU list of (compiled, flops) variants: GSPMD
+        # may hand the first step's outputs back in a different layout
+        # than the plan placed, and the re-lower at the drifted-stable
+        # layout is the same silent recompile jit dispatch performed
+        # here before the AOT path existed
+        self._compiled = {}      # batch sig -> [(compiled|None, flops)]
         pipe_key = None
         if self._pipeline is not None:
             pipe_key = (self._pipeline["M"], self._pipeline["axis"],
@@ -511,8 +523,9 @@ class TrainStep:
             (_ccache.aval_signature(avals), self._cc_extra),
             plan_digest=self._plan.digest()
             if self._plan is not None else None)
-        fn = self._cc.load_executable(key)
+        fn, meta = self._cc.load_executable_entry(key)
         self._cc_fns[sig] = fn
+        self._cc_meta[sig] = meta
         if fn is None:
             self._cc_pending[sig] = (key, avals)
         return fn
@@ -532,34 +545,47 @@ class TrainStep:
         sig = (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "")),
                tuple(getattr(y, "shape", ())), str(getattr(y, "dtype", "")))
         step_fn = self._step
+        flops = None
         if self._cc is not None:
             cached = self._cc_fns[sig] if sig in self._cc_fns else \
                 self._cc_lookup(sig, rng, x, y)
             if cached is not None:
                 # warm start: no trace happens, so no compile event —
                 # the cache-hit counter carries the observability and
-                # the zero-fresh-trace assertion holds by construction
+                # the zero-fresh-trace assertion holds by construction.
+                # The FLOP count rides the cache entry (stored with the
+                # executable), so MFU accounting stays warm too.
                 step_fn = cached
                 self._seen_sigs.add(sig)
+                flops = self._cc_meta.get(sig, {}).get("flops")
         fresh = sig not in self._seen_sigs and len(self._seen_sigs) < 4096
         if fresh:
             import time as _t
 
             self._seen_sigs.add(sig)
             t0 = _t.perf_counter()
+        # plain-dict calling convention for EVERY dispatch (see
+        # _plain_tree): the step's state trees drift OrderedDict→dict
+        # across calls, and both the AOT executable and a cached
+        # exported artifact are structure-strict; key-based flattening
+        # keeps the leaf mapping identical either way
+        args = (self._plain_tree(self.train_params),
+                self._plain_tree(self.rest_params),
+                self._plain_tree(self.opt_state), rng, x, y)
         if step_fn is self._step:
-            loss, self.train_params, self.rest_params, self.opt_state = \
-                step_fn(self.train_params, self.rest_params,
-                        self.opt_state, rng, x, y)
+            # per-signature AOT: the cold path lowers + compiles ONCE
+            # (capturing XLA's cost_analysis FLOPs while the executable
+            # is in hand); steady state is one dict lookup + dispatch —
+            # no retrace, no host sync, no new work
+            out, flops = self._call_aot(sig, args)
         else:
-            # cached executable: plain-dict calling convention (see
-            # _plain_tree); OrderedDict param maps keep their key-based
-            # meaning either way
-            loss, self.train_params, self.rest_params, self.opt_state = \
-                step_fn(self._plain_tree(self.train_params),
-                        self._plain_tree(self.rest_params),
-                        self._plain_tree(self.opt_state), rng, x, y)
+            out = step_fn(*args)
+        loss, self.train_params, self.rest_params, self.opt_state = out
         self.step_count += 1
+        if flops:
+            from .. import introspection as _introspection
+
+            _introspection.account_flops(flops, kind="train_step")
         if fresh:
             from .. import telemetry as _telemetry
 
@@ -572,10 +598,73 @@ class TrainStep:
                 # cold path: persist the executable so the NEXT process
                 # with this signature starts warm (the export re-traces
                 # once — still the cold path, and our tracer already
-                # recorded this signature's compile above)
+                # recorded this signature's compile above).  The FLOP
+                # count rides the entry so the warm process keeps its
+                # MFU gauge without a compile to ask.
                 key, avals = pending
-                self._cc.store_executable(key, self._step, *avals)
+                self._cc.store_executable(
+                    key, self._step, *avals,
+                    meta={"flops": flops} if flops else None)
         return loss
+
+    def _aot_step(self, args):
+        """Lower + compile one operand tuple ahead of time and capture
+        its cost-analysis FLOPs.  Graceful fallback: when the AOT path
+        is unavailable (platform quirk), the jit dispatch path serves
+        the signature and the FLOP count — hence the MFU gauge — is
+        simply absent, never wrong."""
+        try:
+            compiled = self._step.lower(*args).compile()
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"TrainStep AOT compile unavailable ({e!r}); falling "
+                "back to jit dispatch (no per-step FLOPs for this "
+                "signature — MFU gauge unaffected, just unfed)",
+                stacklevel=3)
+            return (None, None)
+        from .. import introspection as _introspection
+
+        return (compiled, _introspection.flops_of(compiled))
+
+    def _call_aot(self, sig, args):
+        """Dispatch one step through the per-signature AOT executables;
+        returns ``(outputs, flops)``.
+
+        A compiled object is layout-STRICT: when GSPMD hands a step's
+        outputs back in a different sharding than it was lowered with
+        (observed on multi-axis meshes — the plan places ``P('tp',
+        None)``, the executable returns ``P('fsdp')``), the next call
+        raises ValueError.  jit dispatch used to absorb exactly this
+        with a silent recompile; here ANY ValueError from a compiled
+        variant falls through to a fresh re-lower at the current
+        operand layout (the error message wording is not a stable API,
+        so no substring matching) — a genuine error reproduces on the
+        freshly-lowered executable and propagates from there, costing
+        one extra compile, never masking.  The small MRU variant list
+        keeps a ping-ponging layout from recompiling every step."""
+        variants = self._compiled.setdefault(sig, [])
+        if not variants:
+            variants.append(self._aot_step(args))
+        for i, (compiled, flops) in enumerate(variants):
+            if compiled is None:
+                # AOT unavailable for this signature: jit dispatch
+                return self._step(*args), None
+            try:
+                out = compiled(*args)
+            except ValueError:
+                continue
+            if i:
+                variants.insert(0, variants.pop(i))
+            return out, flops
+        entry = self._aot_step(args)
+        variants.insert(0, entry)
+        del variants[4:]
+        compiled, flops = entry
+        if compiled is None:
+            return self._step(*args), None
+        return compiled(*args), flops
 
     def run(self, batches, steps=None, prefetch=None):
         """Drive the fused step over an iterator of ``(x, y)`` batches with

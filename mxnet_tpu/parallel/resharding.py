@@ -568,7 +568,10 @@ def apply_transfer(plan, arrays, budget_bytes=None):
         _fault.check("resharding.transfer")
         out = _run()
     _TRANSFERS.inc()
-    _SECONDS.observe(time.perf_counter() - t0)
+    dt = time.perf_counter() - t0
+    _SECONDS.observe(dt)
+    # goodput ledger: transfer wall time is recovery work, not training
+    _telemetry.goodput_note("reshard", dt)
     return out
 
 

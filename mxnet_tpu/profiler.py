@@ -77,16 +77,21 @@ def _instant(name, cat):
                         "ts": (time.perf_counter() - _T0) * 1e6, "cat": cat})
 
 
-def _record_span(name, t0, t1, cat="step_phase", tid=1000):
-    """Telemetry hook: merge a step-phase / compile span into the Chrome
-    trace (its own tid row so phases don't interleave with op events).
-    ``t0``/``t1`` are perf_counter values — the same clock as ``_T0``."""
+def _record_span(name, t0, t1, cat="step_phase", tid=1000, args=None):
+    """Telemetry hook: merge a step-phase / compile / request span into
+    the Chrome trace (its own tid row so phases don't interleave with op
+    events).  ``t0``/``t1`` are perf_counter values — the same clock as
+    ``_T0``; ``args`` (JSON-able dict) lands on the event verbatim (the
+    serving request tracer carries trace ids/outcomes through it)."""
     if _T0 is None or not _ACTIVE or _PAUSED:
         return
+    ev = {"name": name, "ph": "X", "pid": 0, "tid": tid,
+          "ts": (t0 - _T0) * 1e6, "dur": (t1 - t0) * 1e6,
+          "cat": cat}
+    if args:
+        ev["args"] = dict(args)
     with _LOCK:
-        _EVENTS.append({"name": name, "ph": "X", "pid": 0, "tid": tid,
-                        "ts": (t0 - _T0) * 1e6, "dur": (t1 - t0) * 1e6,
-                        "cat": cat})
+        _EVENTS.append(ev)
 
 
 def _counter(name, value):

@@ -43,6 +43,7 @@ import numpy as _np
 from .. import compile_cache as _ccache
 from .. import env as _env
 from .. import fault as _fault
+from .. import introspection as _introspection
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..ndarray import dispatch_cache as _dc
@@ -88,6 +89,10 @@ _G_PAGES = _telemetry.gauge(
 _G_TOKS_S = _telemetry.gauge(
     "mxnet_serving_tokens_per_s",
     "generated tokens/s over the trailing window")
+_G_TOKS_CHIP = _telemetry.gauge(
+    "mxnet_tokens_per_s_per_chip",
+    "generated tokens/s per device over the trailing window (the "
+    "serving half of online utilization accounting)")
 _H_JOIN = _telemetry.histogram(
     "mxnet_serving_join_to_first_token_seconds",
     "replica handoff: wall time from joining (params donated by a "
@@ -126,7 +131,8 @@ class ServingEngine:
     def __init__(self, net, *, batch_buckets=None, prefill_buckets=None,
                  kv_pages=None, page_size=None, queue_bound=None,
                  max_batch=None, deadline_ms=None, name=None, plan=None,
-                 params_from=None, compile_cache=None):
+                 params_from=None, compile_cache=None,
+                 trace_requests=None):
         from ..gluon.model_zoo.language.llama import (LlamaForCausalLM,
                                                       serving_params)
 
@@ -212,6 +218,20 @@ class ServingEngine:
             on_expire=lambda r: _C_REQS.labels(outcome="expired").inc())
         self._active: list = []
         self._exec: dict = {}
+        # per-executable FLOPs from compile-time cost_analysis (same
+        # key space as _exec; None = unavailable — accounting just
+        # skips, the MFU gauge stays absent rather than wrong)
+        self._exec_flops: dict = {}
+        self._n_chips = 1
+        # per-request span traces (serving/tracing.py): explicit kwarg
+        # > MXNET_TRACE_REQUESTS (default on).  The store keeps the
+        # slowest N + every error/evicted trace; /v1/requests serves it
+        from .tracing import TraceStore
+
+        self._trace_enabled = bool(
+            trace_requests if trace_requests is not None
+            else _env.trace_requests())
+        self._traces = TraceStore()
         self._lock = threading.Lock()          # guards _exec + counters
         self._stop_evt = threading.Event()     # close() requested
         self._drain = True                     # finish in-flight on stop
@@ -437,10 +457,13 @@ class ServingEngine:
                  _ccache.aval_signature(pool_aval)),
                 plan_digest=self._plan.digest()
                 if self._plan is not None else None)
-            cached = self._cc.load_executable(ckey)
+            cached, cmeta = self._cc.load_executable_entry(ckey)
             if cached is not None:
+                # warm load: the FLOP count rides the cache entry, so
+                # online MFU accounting stays fed with no compile to ask
                 with self._lock:
                     self._exec[key] = cached
+                    self._exec_flops[key] = cmeta.get("flops")
                 return cached
         if phase == "prefill":
             jit_fn = jax.jit(self._prefill_body(dims["L"], dims["P"]),
@@ -454,14 +477,22 @@ class ServingEngine:
             jit_fn = jax.jit(self._sample_body(dims["B"]), **jit_kw)
             aot_args = tuple(dyn)
         compiled = jit_fn.lower(*aot_args).compile()
+        # per-executable FLOPs, captured ONCE while the compiled object
+        # is in hand (layer 1 of the introspection plane): steady-state
+        # dispatch then accounts a known constant — no cost re-derive,
+        # no host sync
+        flops = _introspection.flops_of(compiled)
         with self._lock:
             self._exec[key] = compiled
+            self._exec_flops[key] = flops
         label = ":".join([self._name, phase] +
                          [f"{k}{v}" for k, v in sorted(dims.items())])
         _telemetry.compile_event("serving", label,
                                  time.perf_counter() - t0, cause)
         if ckey is not None:
-            self._cc.store_executable(ckey, jit_fn, *aot_args)
+            self._cc.store_executable(
+                ckey, jit_fn, *aot_args,
+                meta={"flops": flops} if flops else None)
         return compiled
 
     def _aot_warmup(self):
@@ -484,6 +515,8 @@ class ServingEngine:
         return time.perf_counter() - t0
 
     def _lookup_exec(self, phase, **dims):
+        """``(compiled, flops)`` for one signature; flops is the
+        compile-time cost_analysis count (None = unavailable)."""
         key = self._sig_key(phase, *self._avals(phase, **dims))
         with self._lock:
             compiled = self._exec.get(key)
@@ -493,7 +526,9 @@ class ServingEngine:
             # served, not dropped
             compiled = self._aot_compile(phase, "steady_state_miss",
                                          **dims)
-        return compiled
+        with self._lock:
+            flops = self._exec_flops.get(key)
+        return compiled, flops
 
     # -- replica handoff ---------------------------------------------------
     @classmethod
@@ -514,12 +549,13 @@ class ServingEngine:
         """AOT-compile the manifest and start the engine loop thread."""
         if self._thread is not None:
             return self
+        import jax
+
+        self._n_chips = max(1, jax.device_count())
         if self._plan is not None:
             # the executables expect every operand on the plan's mesh:
             # replicate the KV pools once up front (they stay replicated
             # through the donate round trip, so this is one-time work)
-            import jax
-
             self._kv.k_pool = jax.device_put(self._kv.k_pool,
                                              self._rep_sharding)
             self._kv.v_pool = jax.device_put(self._kv.v_pool,
@@ -572,6 +608,13 @@ class ServingEngine:
                       temperature=temperature, eos_id=eos_id,
                       deadline_ms=deadline_ms if deadline_ms is not None
                       else (self._deadline_ms or None))
+        if self._trace_enabled:
+            from .tracing import RequestTrace
+
+            req.trace = RequestTrace(req.id)
+            req.trace.event("submitted", prompt_len=int(req.prompt.size),
+                            max_new_tokens=req.max_new_tokens)
+            req.on_resolve = self._trace_finished
         if req.temperature > 0:
             req.key = self._request_key()
         L = int(req.prompt.size)
@@ -714,6 +757,7 @@ class ServingEngine:
             toks = sum(n for _, n in list(win)[1:])
             if dt > 0:
                 _G_TOKS_S.set(toks / dt)
+                _G_TOKS_CHIP.set(toks / dt / self._n_chips)
 
     def _admit(self, req):
         """Prefill one request (or its post-eviction continuation).
@@ -721,6 +765,7 @@ class ServingEngine:
         requeued)."""
         import jax.numpy as jnp
 
+        tr = req.trace
         try:
             # chaos seam: a tripped admission loses nothing — the
             # request returns to the queue FRONT and the next loop
@@ -729,9 +774,14 @@ class ServingEngine:
         except Exception as e:
             _LOGGER.warning("serving.admit fault for request %s (%r); "
                             "requeued", req.id, e)
+            if tr is not None:
+                tr.event("requeued", reason="admit_fault")
+                tr.last_enqueue_t = time.perf_counter()
             self._queue.requeue(req)
             return False
         if req.expired():
+            if tr is not None:
+                tr.event("deadline_expired", where="prefill")
             req.resolve(DeadlineExceededError(
                 f"request {req.id} expired before prefill"))
             _C_REQS.labels(outcome="expired").inc()
@@ -757,11 +807,24 @@ class ServingEngine:
         # work waits for free pages; eviction is reserved for GROWTH of
         # already-running sequences (_decode_step).
         if not self._kv.alloc(req.id, L):
+            if tr is not None:
+                tr.event("requeued", reason="pool_full")
+                tr.last_enqueue_t = time.perf_counter()
             self._queue.requeue(req)
             return False
         Lb = bucket_for(L, self._prefill_buckets)
         P = bucket_for(pages_for(L, self._page_size), self._page_buckets)
-        compiled = self._lookup_exec("prefill", L=Lb, P=P)
+        # close the queue span BEFORE the executable lookup: a
+        # steady-state miss compiles for seconds, and that time must
+        # read as a compile, never as queue congestion
+        t_q_end = time.perf_counter()
+        if tr is not None:
+            tr.add_span("queue_wait", tr.last_enqueue_t, t_q_end,
+                        prefills=req.prefills)
+        compiled, flops = self._lookup_exec("prefill", L=Lb, P=P)
+        t_pre = time.perf_counter()
+        if tr is not None and t_pre - t_q_end > 1e-3:
+            tr.add_span("compile_wait", t_q_end, t_pre, bucket=Lb)
         ids = jnp.asarray(_np.concatenate(
             [ids_full, _np.zeros(Lb - L, dtype=_np.int32)])[None, :])
         table = jnp.asarray(
@@ -770,10 +833,19 @@ class ServingEngine:
             self._params, self._kv.k_pool, self._kv.v_pool, ids,
             _np.int32(L), table)
         self._kv.k_pool, self._kv.v_pool = kp, vp
+        if flops:
+            _introspection.account_flops(flops, kind="serving_prefill")
         req.prefills += 1
         if req.prefills == 1:
             _C_TOKENS.labels(kind="prompt").inc(L)
+        t_sm = time.perf_counter()
+        pid = tr.add_span("prefill", t_pre, t_sm, tokens=L, bucket=Lb) \
+            if tr is not None else 0
         tok = self._sample([last_logits], [req])[0]
+        if tr is not None:
+            # the host-side clock: prefill dispatch is async, the
+            # sample's fused token fetch is where the wall time lands
+            tr.add_span("sample", t_sm, time.perf_counter(), parent=pid)
         if req.first_token_t is None:
             req.first_token_t = time.monotonic()
             _H_TTFT.observe(req.first_token_t - req.submitted)
@@ -811,6 +883,11 @@ class ServingEngine:
         prompt plus everything generated so far re-prefills later)."""
         self._active.remove(seq)
         self._kv.free(seq.req.id)
+        tr = seq.req.trace
+        if tr is not None:
+            tr.event("evicted", cache_len=seq.cache_len,
+                     generated=len(seq.req.tokens))
+            tr.last_enqueue_t = time.perf_counter()
         self._queue.requeue(seq.req)
         _C_EVICT.inc()
 
@@ -848,7 +925,7 @@ class ServingEngine:
         max_pages = max(pages_for(s.cache_len + 1, self._page_size)
                         for s in self._active)
         P = bucket_for(max_pages, self._page_buckets)
-        compiled = self._lookup_exec("decode", B=Bb, P=P)
+        compiled, flops = self._lookup_exec("decode", B=Bb, P=P)
         pad = Bb - B
         sids = [s.req.id for s in self._active] + [None] * pad
         ids = jnp.asarray([s.last_token for s in self._active] + [0] * pad,
@@ -856,16 +933,30 @@ class ServingEngine:
         pos = jnp.asarray([s.cache_len for s in self._active] + [0] * pad,
                           dtype=jnp.int32)
         table = jnp.asarray(self._kv.table_rows(sids, P), dtype=jnp.int32)
+        t_dec = time.perf_counter()
         logits, kp, vp = compiled(self._params, self._kv.k_pool,
                                   self._kv.v_pool, ids, pos, table)
         self._kv.k_pool, self._kv.v_pool = kp, vp
+        if flops:
+            _introspection.account_flops(flops, kind="serving_decode")
         _H_OCCUPANCY.observe(B / Bb)
         rows = list(self._active)
+        t_sm = time.perf_counter()
         toks = self._sample(logits, [s.req for s in rows], batched=True)
+        t_done = time.perf_counter()
         now = time.monotonic()
         n_new = 0
         for seq, tok in zip(rows, toks):
             req = seq.req
+            tr = req.trace
+            if tr is not None:
+                # per-decode-step residency: this request rode THIS
+                # batched step (host-side stamps; the sample child is
+                # where the one fused token fetch lands)
+                did = tr.add_span("decode_step", t_dec, t_sm,
+                                  step=len(req.tokens), batch=B,
+                                  bucket=Bb)
+                tr.add_span("sample", t_sm, t_done, parent=did)
             seq.cache_len += 1
             seq.last_token = tok
             req.tokens.append(tok)
@@ -896,13 +987,36 @@ class ServingEngine:
         keys = [r.key if r.key is not None else zero_key
                 for r in reqs] + [zero_key] * pad
         steps = [len(r.tokens) for r in reqs] + [0] * pad
-        compiled = self._lookup_exec("sample", B=B)
+        compiled, flops = self._lookup_exec("sample", B=B)
         toks = compiled(lg, jnp.asarray(_np.stack(keys)),
                         jnp.asarray(steps, dtype=jnp.int32),
                         jnp.asarray(temps, dtype=jnp.float32))
+        if flops:
+            _introspection.account_flops(flops, kind="serving_sample")
         # mxtpu: noqa[MXT010] ONE fused token fetch per engine step IS the design (has_overflow precedent)
         host = _np.asarray(toks)
         return [int(t) for t in host[:len(reqs)]]
+
+    def _trace_finished(self, req):
+        """Request.resolve hook: classify the outcome, file the trace
+        in the tail-retention store, and merge its spans into the
+        Chrome trace when the profiler is active.  Every resolution
+        path (finish, queue/prefill deadline, shutdown drain, step
+        failure) flows through resolve(), so this one hook sees them
+        all — host-side work only."""
+        tr = req.trace
+        if tr is None:
+            return
+        err = req.error
+        if err is None:
+            outcome = req.finish_reason or "done"
+        elif isinstance(err, DeadlineExceededError):
+            outcome = "expired"
+        else:
+            outcome = "error"
+        tr.finish(outcome, error=err)
+        self._traces.add(tr)
+        tr.emit_chrome()
 
     def _is_finished(self, req, tok, ctx_next):
         return (len(req.tokens) >= req.max_new_tokens
@@ -975,20 +1089,27 @@ class ServingEngine:
             "latency_s": {"p50": pct(0.50), "p99": pct(0.99),
                           "count": len(lat)},
             "tokens_per_s": _G_TOKS_S.value,
+            "tokens_per_s_per_chip": _G_TOKS_CHIP.value,
             "context_cap": self._ctx_cap,
             "buckets": {"batch": self._batch_buckets,
                         "prefill": self._prefill_buckets,
                         "pages": self._page_buckets},
+            "request_traces": {"enabled": self._trace_enabled,
+                               "traced": self._traces.count()},
         }
 
     # -- HTTP plane (mounted beside /metrics on the telemetry server) ------
     def mount_http(self, prefix="/v1"):
-        """Register ``{prefix}/completions`` (POST) and
-        ``{prefix}/serving`` (GET) on the telemetry HTTP endpoint."""
+        """Register ``{prefix}/completions`` (POST), ``{prefix}/serving``
+        (GET), and the ``{prefix}/requests`` trace-debug route (GET:
+        the tail-retained per-request span trees) on the telemetry HTTP
+        endpoint."""
         comp, stat = prefix + "/completions", prefix + "/serving"
+        reqs = prefix + "/requests"
         _telemetry.register_http_route(comp, self._http_completions)
         _telemetry.register_http_route(stat, self._http_stats)
-        self._mounted = [comp, stat]
+        _telemetry.register_http_route(reqs, self._http_requests)
+        self._mounted = [comp, stat, reqs]
         return self
 
     def unmount_http(self):
@@ -998,6 +1119,11 @@ class ServingEngine:
 
     def _http_stats(self, method, path, query, body):
         return 200, "application/json", json.dumps(self.stats()).encode()
+
+    def _http_requests(self, method, path, query, body):
+        doc = self._traces.snapshot()
+        doc["enabled"] = self._trace_enabled
+        return 200, "application/json", json.dumps(doc).encode()
 
     def _http_completions(self, method, path, query, body):
         from .scheduler import QueueFullError

@@ -76,7 +76,7 @@ class Request:
     __slots__ = ("id", "prompt", "max_new_tokens", "temperature", "eos_id",
                  "deadline", "submitted", "first_token_t", "finished_t",
                  "tokens", "error", "_done", "prefills", "key",
-                 "finish_reason")
+                 "finish_reason", "trace", "on_resolve")
 
     def __init__(self, prompt, max_new_tokens=16, temperature=0.0,
                  eos_id=None, deadline_ms=None):
@@ -106,6 +106,14 @@ class Request:
         # traffic, and reproducible under mx.random.seed.
         self.key = None
         self.finish_reason = None   # "stop" (eos) | "length" (caps)
+        # per-request introspection (serving/tracing.py): the engine
+        # attaches a RequestTrace at submit when MXNET_TRACE_REQUESTS
+        # is on, and an on_resolve hook that files the finished trace —
+        # every resolution path (finish, deadline, eviction-drain,
+        # shutdown, step failure) flows through resolve(), so one hook
+        # covers them all
+        self.trace = None
+        self.on_resolve = None
 
     def full_ids(self):
         """Prompt plus everything generated so far — the prefill input
@@ -119,6 +127,12 @@ class Request:
     def resolve(self, error=None):
         self.error = error
         self.finished_t = time.monotonic()
+        hook = self.on_resolve
+        if hook is not None:
+            try:
+                hook(self)
+            except Exception:   # tracing must never fail a request
+                pass
         self._done.set()
 
     def expired(self, now=None):
@@ -195,6 +209,9 @@ class AdmissionQueue:
             while self._items:
                 req = self._items.pop(0)
                 if req.expired(now):
+                    if req.trace is not None:
+                        req.trace.event("deadline_expired",
+                                        where="queue")
                     req.resolve(DeadlineExceededError(
                         f"request {req.id} expired after "
                         f"{now - req.submitted:.3f}s in queue"))

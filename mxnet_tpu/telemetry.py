@@ -55,6 +55,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "register_http_route", "unregister_http_route",
            "step_begin", "step_end", "step_abort", "step_scope", "phase",
            "maybe_phase", "timeline", "compile_event", "compile_events",
+           "goodput_note", "goodput_summary",
            "heartbeat", "last_heartbeat", "reset"]
 
 _LOCK = threading.RLock()
@@ -306,6 +307,51 @@ _PHASE_HIST = histogram(
 _STEP_HIST = histogram("mxnet_step_seconds", "training step wall time")
 _STEPS_TOTAL = counter("mxnet_steps_total", "completed timeline steps")
 
+# goodput ledger: wall time classified into what the job was DOING.
+# "productive" accrues automatically from the step timeline (step wall
+# minus any in-step checkpoint phase); the non-productive buckets are
+# noted by the lifecycle/recovery seams that own them — checkpoint
+# saves, run_with_recovery restart downtime, live resharding transfers,
+# watchdog-diagnosed stalls.  The ratio gauge is computed at export
+# time by a collector so recording stays one counter add.
+_GOODPUT = counter(
+    "mxnet_goodput_seconds_total",
+    "wall time by goodput bucket (productive = step wall minus in-step "
+    "checkpoint time; checkpoint/restart/reshard/stall noted by their "
+    "owning seams)", labelnames=("bucket",))
+
+
+def goodput_note(bucket, seconds):
+    """Charge ``seconds`` of wall time to a goodput ``bucket``
+    (``checkpoint`` / ``restart`` / ``reshard`` / ``stall`` / caller-
+    defined).  ``productive`` accrues automatically from the step
+    timeline — loops never call this themselves."""
+    if seconds > 0:
+        _GOODPUT.labels(bucket=str(bucket)).inc(float(seconds))
+
+
+def goodput_summary():
+    """``{"buckets": {...seconds...}, "tracked_s", "productive_ratio"}``
+    — productive wall time over everything the ledger has classified
+    (``productive_ratio`` is None until anything was tracked)."""
+    buckets = {}
+    for values, child in _GOODPUT.children():
+        buckets[values[0]] = child.value
+    total = sum(buckets.values())
+    prod = buckets.get("productive", 0.0)
+    return {"buckets": buckets, "tracked_s": total,
+            "productive_ratio": (prod / total) if total > 0 else None}
+
+
+def _goodput_collector():
+    s = goodput_summary()
+    if s["productive_ratio"] is None:
+        return []
+    return [{"name": "mxnet_goodput_ratio", "type": "gauge",
+             "help": "productive wall time over all ledger-classified "
+                     "time (goodput)",
+             "samples": [({}, s["productive_ratio"])]}]
+
 
 def _chrome_span(name, t0, t1, cat):
     try:
@@ -379,6 +425,12 @@ def _finalize_locked(now):
         _PHASE_HIST.labels(phase=pname).observe(dt)
     _STEP_HIST.observe(wall)
     _STEPS_TOTAL.inc()
+    # goodput: a step is productive time EXCEPT what it spent inside a
+    # checkpoint save (that phase is charged to the checkpoint bucket by
+    # the save path itself — charging it here too would double-count)
+    prod = wall - phases.get("checkpoint", 0.0)
+    if prod > 0:
+        _GOODPUT.labels(bucket="productive").inc(prod)
     _chrome_span(f"step {cur['step']}", cur["t0"], now, "step")
     return rec
 
@@ -388,7 +440,25 @@ def step_end():
     the step wall time — unattributed time lands in ``other``)."""
     heartbeat()
     with _LOCK:
-        return _finalize_locked(time.perf_counter())
+        rec = _finalize_locked(time.perf_counter())
+    _agg_tick()
+    return rec
+
+
+def _agg_tick():
+    """Cross-rank aggregation stride hook: every completed step (and
+    every ``lifecycle.check_stop``) advances the aggregator's tick
+    counter; every ``MXNET_TELEMETRY_AGG_EVERY``-th tick publishes this
+    rank's snapshot and (on rank 0) merges the peers'.  Pure host-side
+    file IO — NEVER a device collective — so it is safe at any stride
+    and cannot desync SPMD peers.  A disabled aggregator costs one
+    module-dict lookup and an int check."""
+    try:
+        from . import telemetry_agg as _agg
+
+        _agg.tick()
+    except Exception:   # aggregation must never break a step boundary
+        pass
 
 
 def step_abort():
@@ -578,6 +648,7 @@ def _fault_collector():
 
 register_collector(_dispatch_cache_collector)
 register_collector(_fault_collector)
+register_collector(_goodput_collector)
 
 
 # --------------------------------------------------------------------------
@@ -695,6 +766,7 @@ def snapshot():
         "compile_events": events,
         "compile": {"count": int(n_compiles), "total_s": compile_s,
                     "events_kept": len(events)},
+        "goodput": goodput_summary(),
         "graph": _graph_section(),
     }
 

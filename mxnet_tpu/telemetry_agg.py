@@ -1,0 +1,351 @@
+"""Cross-rank telemetry aggregation: one merged, rank-labeled view of a
+multi-process job, with per-phase straggler skew.
+
+Layer 3 of the runtime introspection plane (ISSUE 14).  Every rank has
+had a complete ``telemetry.snapshot()`` since PR 3 — but each one is an
+island: rank 7 being 40 ms slower in its ``data`` phase every step is
+invisible until it becomes a watchdog stall.  This module merges the
+per-rank snapshots into
+
+- **rank-labeled families** — every metric family from every rank, its
+  samples carrying a ``rank`` label, in one document; and
+- **per-phase skew histograms** — ``mxnet_rank_step_skew_seconds``
+  observes, per phase, ``max - min`` of the per-rank durations at the
+  newest step every rank has reported, so a straggler is a visible
+  distribution long before it wedges the mesh.
+
+Transport contract — **never a device collective**: ranks exchange
+snapshots through atomically-published JSON files in a shared directory
+(``MXNET_TELEMETRY_AGG_DIR``).  The publish rides the existing uniform
+step boundary (``telemetry.step_end`` and ``lifecycle.check_stop``
+both tick the stride counter) purely because that is where a
+consistent per-step cut exists — the IO is host-side, so a rank
+publishing late or not at all degrades the merge, never the job
+(MXT001/003 have nothing to taint).  Every
+``MXNET_TELEMETRY_AGG_EVERY``-th tick a rank rewrites its own
+``rank<N>.json``; rank 0 additionally merges whatever peer files exist
+and serves the result at the ``/agg`` route beside ``/metrics``.
+
+:func:`merge_snapshots` itself is a pure, deterministic function of its
+inputs (CI asserts two merges of the same snapshots are identical), so
+``tools/teldump`` can re-merge offline from the same files.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+
+from . import env as _env
+from . import telemetry as _telemetry
+
+__all__ = ["merge_snapshots", "skew_from_snapshots", "configure",
+           "tick", "publish", "merge_dir", "read_dir", "merged",
+           "reset"]
+
+_SKEW_HIST = _telemetry.histogram(
+    "mxnet_rank_step_skew_seconds",
+    "per-phase max-min spread of step-phase durations across ranks at "
+    "the newest common step (straggler visibility)",
+    labelnames=("phase",),
+    buckets=[1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0, 3.0,
+             10.0])
+_MERGES = _telemetry.counter(
+    "mxnet_telemetry_agg_merges_total",
+    "cross-rank snapshot merges performed by this process")
+_AGG_RANKS = _telemetry.gauge(
+    "mxnet_telemetry_agg_ranks",
+    "ranks present in the latest cross-rank merge")
+
+_LOCK = threading.Lock()
+_STATE = {
+    "configured": False,
+    "dir": None,
+    "every": 0,
+    "rank": 0,
+    "world": 1,
+    "ticks": 0,
+    "merged": None,      # latest merged doc (aggregating rank only)
+    "route": False,
+    "warned": False,
+}
+
+_RANK_FILE = re.compile(r"^rank(\d+)\.json$")
+
+
+# --------------------------------------------------------------------------
+# pure merge (deterministic: same snapshots in -> same document out)
+# --------------------------------------------------------------------------
+def merge_snapshots(snaps):
+    """Merge ``{rank: telemetry-snapshot}`` into one document.
+
+    Deterministic and pure: ranks are processed in sorted order, no
+    clock reads feed the payload (the newest input snapshot's ``time``
+    is carried through), so merging the same inputs twice yields the
+    same document — the property teldump's offline re-merge and the CI
+    determinism assertion rely on.
+
+    Output shape::
+
+        {"time", "ranks": [...], "metrics": {name: {type, help,
+         samples: [{labels: {..., "rank": "0"}, ...}]}},
+         "skew": {"step": N|None, "phases": {phase: max-min}},
+         "per_rank": {rank: {steps, last_step, compile_count,
+                             goodput_ratio}}}
+    """
+    snaps = {int(r): s for r, s in dict(snaps).items()}
+    ranks = sorted(snaps)
+    metrics: dict = {}
+    per_rank: dict = {}
+    for rank in ranks:
+        snap = snaps[rank]
+        for name, fam in sorted((snap.get("metrics") or {}).items()):
+            out = metrics.setdefault(
+                name, {"type": fam.get("type"),
+                       "help": fam.get("help", ""), "samples": []})
+            for sample in fam.get("samples", ()):
+                labeled = dict(sample)
+                labels = dict(labeled.get("labels") or {})
+                labels["rank"] = str(rank)
+                labeled["labels"] = labels
+                out["samples"].append(labeled)
+        steps = snap.get("steps") or []
+        per_rank[rank] = {
+            "steps": len(steps),
+            "last_step": steps[-1]["step"] if steps else None,
+            "compile_count": (snap.get("compile") or {}).get("count"),
+            "goodput_ratio": (snap.get("goodput") or {}).get(
+                "productive_ratio"),
+        }
+    step, phases = skew_from_snapshots(snaps)
+    return {
+        "time": max((s.get("time") or 0) for s in snaps.values())
+        if snaps else 0,
+        "ranks": ranks,
+        "metrics": metrics,
+        "skew": {"step": step, "phases": phases},
+        "per_rank": per_rank,
+    }
+
+
+def skew_from_snapshots(snaps):
+    """``(step, {phase: max-min seconds})`` at the newest step EVERY
+    rank has a timeline record for (``(None, {})`` when there is no
+    common step — e.g. a rank that has not completed a step yet)."""
+    per_rank_steps = {}
+    for rank, snap in snaps.items():
+        per_rank_steps[rank] = {rec["step"]: rec
+                                for rec in (snap.get("steps") or [])}
+    if not per_rank_steps or any(not d for d in per_rank_steps.values()):
+        return None, {}
+    common = set.intersection(*(set(d) for d in per_rank_steps.values()))
+    if not common:
+        return None, {}
+    step = max(common)
+    phases: dict = {}
+    names = set()
+    for d in per_rank_steps.values():
+        names.update(d[step]["phases"])
+    for name in sorted(names):
+        vals = [d[step]["phases"].get(name, 0.0)
+                for d in per_rank_steps.values()]
+        phases[name] = max(vals) - min(vals)
+    return step, phases
+
+
+# --------------------------------------------------------------------------
+# the file-based gather
+# --------------------------------------------------------------------------
+def configure(directory=None, every=None, rank=None, world=None):
+    """Configure (or reconfigure) the aggregator explicitly.  Defaults
+    come from the env knobs / launcher vars; ``every=0`` disables."""
+    with _LOCK:
+        _STATE["dir"] = directory if directory is not None \
+            else _env.telemetry_agg_dir()
+        _STATE["every"] = int(every if every is not None
+                              else _env.telemetry_agg_every())
+        _STATE["rank"] = int(rank if rank is not None else _launcher_rank())
+        _STATE["world"] = int(world if world is not None
+                              else _launcher_world())
+        _STATE["configured"] = True
+        _STATE["ticks"] = 0
+        if _STATE["every"] > 0 and not _STATE["dir"] \
+                and not _STATE["warned"]:
+            _STATE["warned"] = True
+            import warnings
+
+            warnings.warn(
+                "MXNET_TELEMETRY_AGG_EVERY is set but "
+                "MXNET_TELEMETRY_AGG_DIR is not: cross-rank telemetry "
+                "aggregation stays OFF (the ranks need a shared "
+                "directory to publish into)", stacklevel=2)
+    return dict(_STATE)
+
+
+def _launcher_rank():
+    # launcher env, NOT jax.process_index(): the tick must never force
+    # backend init (the PR 2 checkpoint-primary-election precedent)
+    for name in ("MXNET_WORKER_ID", "DMLC_WORKER_ID"):
+        v = os.environ.get(name)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
+
+
+def _launcher_world():
+    for name in ("MXNET_NUM_WORKERS", "DMLC_NUM_WORKER"):
+        v = os.environ.get(name)
+        if v:
+            try:
+                return max(1, int(v))
+            except ValueError:
+                pass
+    return 1
+
+
+def tick():
+    """One step-boundary tick (called by ``telemetry.step_end`` and
+    ``lifecycle.check_stop``).  Disabled = one dict read + int check.
+    Every ``every``-th tick: publish this rank's snapshot; on rank 0
+    also merge the directory.  Host-side file IO only."""
+    with _LOCK:
+        if not _STATE["configured"]:
+            _configure_locked_from_env()
+        if _STATE["every"] <= 0 or not _STATE["dir"]:
+            return None
+        _STATE["ticks"] += 1
+        if _STATE["ticks"] % _STATE["every"] != 0:
+            return None
+        rank = _STATE["rank"]
+        directory = _STATE["dir"]
+    publish(directory, rank)
+    if rank == 0:
+        doc = merge_dir(directory)
+        with _LOCK:
+            _STATE["merged"] = doc
+            if not _STATE["route"]:
+                _STATE["route"] = True
+                _telemetry.register_http_route("/agg", _http_agg)
+        return doc
+    return None
+
+
+def _configure_locked_from_env():
+    _STATE["dir"] = _env.telemetry_agg_dir()
+    _STATE["every"] = _env.telemetry_agg_every()
+    _STATE["rank"] = _launcher_rank()
+    _STATE["world"] = _launcher_world()
+    _STATE["configured"] = True
+    if _STATE["every"] > 0 and not _STATE["dir"] and not _STATE["warned"]:
+        # the production (env-only) path must warn about the half-set
+        # config exactly like explicit configure() does — silence here
+        # would leave the operator discovering a 404 at /agg instead
+        _STATE["warned"] = True
+        import warnings
+
+        warnings.warn(
+            "MXNET_TELEMETRY_AGG_EVERY is set but "
+            "MXNET_TELEMETRY_AGG_DIR is not: cross-rank telemetry "
+            "aggregation stays OFF (the ranks need a shared directory "
+            "to publish into)", stacklevel=2)
+
+
+def publish(directory, rank):
+    """Atomically write this rank's current snapshot to
+    ``rank<N>.json`` (tmp + rename — a reader never sees a torn file;
+    the newest publish simply wins)."""
+    os.makedirs(directory, exist_ok=True)
+    snap = _telemetry.snapshot()
+    snap["rank"] = int(rank)
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".tmp_agg_")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, os.path.join(directory, f"rank{int(rank)}.json"))
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    return True
+
+
+def read_dir(directory, max_age_s=600.0):
+    """``{rank: snapshot}`` from every readable ``rank*.json`` in the
+    directory (a torn/missing peer file is skipped — the merge is
+    best-effort by design).
+
+    Staleness filter: a rank that left the job (elastic shrink,
+    restart under a new world size) stops publishing but its file
+    persists; without a filter it would pin a frozen rank into every
+    merge forever — and once the live ranks' timeline rings advance
+    past its last step, the skew histogram would silently stop finding
+    a common step.  Snapshots more than ``max_age_s`` older than the
+    NEWEST snapshot in the directory are dropped (measured against the
+    newest file, not the wall clock, so an offline teldump re-merge of
+    an old directory is deterministic and complete).  ``max_age_s <=
+    0`` disables the filter."""
+    snaps = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return snaps
+    for name in sorted(names):
+        m = _RANK_FILE.match(name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                snaps[int(m.group(1))] = json.load(f)
+        except (OSError, ValueError):
+            continue
+    if snaps and max_age_s and max_age_s > 0:
+        newest = max((s.get("time") or 0) for s in snaps.values())
+        snaps = {r: s for r, s in snaps.items()
+                 if (s.get("time") or 0) >= newest - max_age_s}
+    return snaps
+
+
+def merge_dir(directory):
+    """Merge every rank file in ``directory`` and feed the straggler
+    histogram (``mxnet_rank_step_skew_seconds``) with the per-phase
+    skew at the newest common step.  Returns the merged doc."""
+    snaps = read_dir(directory)
+    doc = merge_snapshots(snaps)
+    _MERGES.inc()
+    _AGG_RANKS.set(len(doc["ranks"]))
+    for phase, skew in doc["skew"]["phases"].items():
+        _SKEW_HIST.labels(phase=phase).observe(skew)
+    return doc
+
+
+def merged():
+    """The latest merged document on the aggregating rank (None before
+    the first merge / on non-zero ranks)."""
+    with _LOCK:
+        return _STATE["merged"]
+
+
+def _http_agg(method, path, query, body):
+    doc = merged()
+    if doc is None:
+        return (404, "application/json",
+                b'{"error": "no cross-rank merge yet"}')
+    return 200, "application/json", json.dumps(doc).encode()
+
+
+def reset():
+    """Drop configuration + cached merge (test isolation)."""
+    with _LOCK:
+        _STATE.update(configured=False, dir=None, every=0, rank=0,
+                      world=1, ticks=0, merged=None, warned=False)
+        if _STATE["route"]:
+            _STATE["route"] = False
+            _telemetry.unregister_http_route("/agg")

@@ -83,13 +83,14 @@ def test_pass_catalog_complete():
                            "host-sync-hot-path", "lock-thread-hygiene",
                            "env-knob-registry", "fault-seam-integrity",
                            "serving-hot-path", "planner-sharding",
-                           "graph-pass-contracts", "resharding-transfer"}
+                           "graph-pass-contracts", "resharding-transfer",
+                           "metric-registry"}
     all_codes = {c for cls in passes.values() for c in cls.codes}
     assert all_codes == {"MXT001", "MXT002", "MXT003", "MXT005",
                          "MXT006", "MXT010", "MXT020", "MXT021",
                          "MXT022", "MXT030", "MXT031", "MXT032",
                          "MXT040", "MXT050", "MXT060", "MXT070",
-                         "MXT071", "MXT080"}
+                         "MXT071", "MXT080", "MXT090", "MXT091"}
 
 
 def test_parse_error_reported_not_fatal(tmp_path):
@@ -797,6 +798,101 @@ def test_mxt031_respects_reads_outside_scanned_roots(tmp_path):
         FORCE = os.environ.get("MXNET_BETA")
         """)
     assert not codes_at(check(tmp_path), "MXT031")
+
+
+# -- MXT090/091 metric registry ---------------------------------------------
+CATALOG_README = """MXNET_ALPHA and MXNET_BETA
+
+**Metric catalog**
+
+| family | what |
+|---|---|
+| `good_total`, `multi_{a,b}_total` | counters |
+| `labeled_gauge{label}` | gauge |
+| `fault_seam_{calls,trips}_total{seam}` | collector pattern |
+"""
+
+MET_FIXTURE = """
+    from . import telemetry as _telemetry
+
+    GOOD = _telemetry.counter("mxnet_good_total", "ok")
+    A = _telemetry.counter("mxnet_multi_a_total", "ok")
+    B = _telemetry.counter("mxnet_multi_b_total", "ok")
+    G = _telemetry.gauge("mxnet_labeled_gauge", "ok",
+                         labelnames=("label",))
+
+    def collector(metric):
+        fams = [{"name": f"mxnet_fault_seam_{metric}_total",
+                 "type": "counter", "samples": []}]
+        fams.append({"name": "mxnet_tpu_model", "node": []})
+        return fams
+    """
+
+
+def test_mxt090_uncataloged_registration(tmp_path):
+    mini_repo(tmp_path, readme=CATALOG_README)
+    put(tmp_path, "mxnet_tpu/met.py", MET_FIXTURE + """
+    ROGUE = _telemetry.histogram("mxnet_rogue_seconds", "bad")
+""")
+    findings = check(tmp_path)
+    f090 = [f for f in findings if f.code == "MXT090"]
+    assert [(f.path, "mxnet_rogue_seconds" in f.message)
+            for f in f090] == [("mxnet_tpu/met.py", True)]
+    # the catalog-covered names (incl. {a,b} expansion, trailing-label
+    # braces, and the f-string pattern row) stay silent; the non-family
+    # {"name": ...} dict (no "samples" key) is not a registration
+    assert not [f for f in findings if f.code == "MXT091"]
+
+
+def test_mxt091_dead_catalog_row(tmp_path):
+    mini_repo(tmp_path, readme=CATALOG_README.replace(
+        "| `labeled_gauge{label}` | gauge |",
+        "| `labeled_gauge{label}` | gauge |\n"
+        "| `dead_row_total` | documented but never registered |"))
+    put(tmp_path, "mxnet_tpu/met.py", MET_FIXTURE)
+    findings = check(tmp_path)
+    f091 = [f for f in findings if f.code == "MXT091"]
+    assert len(f091) == 1 and "dead_row_total" in f091[0].message
+    assert f091[0].path == "README.md"
+    assert not [f for f in findings if f.code == "MXT090"]
+
+
+def test_mxt090_pattern_needs_a_covering_row(tmp_path):
+    # the f-string registration's catalog row removed: the PATTERN is
+    # flagged (at the f-string), not each impossible expansion
+    mini_repo(tmp_path, readme=CATALOG_README.replace(
+        "| `fault_seam_{calls,trips}_total{seam}` | collector pattern |\n",
+        ""))
+    put(tmp_path, "mxnet_tpu/met.py", MET_FIXTURE)
+    f090 = codes_at(check(tmp_path), "MXT090")
+    assert f090 and all(p == "mxnet_tpu/met.py" for p, _ in f090)
+
+
+def test_mxt090_inert_without_catalog_and_outside_lib(tmp_path):
+    # no **Metric catalog** marker -> the pass is inert (fixture repos);
+    # registrations in tests/ never count either way
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/met.py", """
+        from . import telemetry as _telemetry
+
+        ROGUE = _telemetry.counter("mxnet_rogue_total", "x")
+        """)
+    put(tmp_path, "tests/test_met.py", """
+        from mxnet_tpu import telemetry
+
+        FAKE = telemetry.counter("mxnet_testonly_total", "x")
+        """)
+    findings = check(tmp_path, roots=("mxnet_tpu", "tests"))
+    assert not [f for f in findings if f.code in ("MXT090", "MXT091")]
+
+
+def test_mxt090_noqa_waiver(tmp_path):
+    mini_repo(tmp_path, readme=CATALOG_README)
+    put(tmp_path, "mxnet_tpu/met.py", MET_FIXTURE + """
+    # mxtpu: noqa[MXT090] internal-only family, deliberately uncataloged
+    ROGUE = _telemetry.histogram("mxnet_rogue_seconds", "bad")
+""")
+    assert not codes_at(check(tmp_path), "MXT090")
 
 
 # -- MXT040 fault seams ------------------------------------------------------

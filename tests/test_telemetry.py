@@ -451,3 +451,363 @@ def test_smoke_train_loop_acceptance(tmp_path):
     assert "step_phase" in cats and "step" in cats
     assert "telemetry" in data["otherData"]
     assert data["otherData"]["telemetry"]["steps"]
+
+
+# --------------------------------------------------------------------------
+# ISSUE 14: runtime introspection plane
+# --------------------------------------------------------------------------
+def test_request_trace_span_tree_nesting():
+    from mxnet_tpu.serving.tracing import RequestTrace
+
+    tr = RequestTrace(7)
+    q = tr.add_span("queue_wait", tr.t0, tr.t0 + 0.01)
+    p = tr.add_span("prefill", tr.t0 + 0.01, tr.t0 + 0.05, tokens=3)
+    tr.add_span("sample", tr.t0 + 0.04, tr.t0 + 0.05, parent=p)
+    d = tr.add_span("decode_step", tr.t0 + 0.05, tr.t0 + 0.06, step=1)
+    tr.add_span("sample", tr.t0 + 0.055, tr.t0 + 0.06, parent=d)
+    tr.event("evicted", cache_len=9)
+    tr.finish("length")
+    doc = tr.to_dict()
+    root = doc["tree"]
+    assert [c["name"] for c in root["children"]] == \
+        ["queue_wait", "prefill", "decode_step"]
+    prefill = root["children"][1]
+    assert [c["name"] for c in prefill["children"]] == ["sample"]
+    assert prefill["attrs"] == {"tokens": 3}
+    decode = root["children"][2]
+    assert [c["name"] for c in decode["children"]] == ["sample"]
+    assert doc["evicted"] is True
+    assert doc["outcome"] == "length"
+    assert doc["events"][0]["name"] == "evicted"
+    assert q == 1  # span ids are stable, root is 0
+    json.dumps(doc)  # JSON-able end to end
+
+
+def test_request_trace_span_cap_counts_overflow():
+    from mxnet_tpu.serving import tracing
+    from mxnet_tpu.serving.tracing import RequestTrace
+
+    tr = RequestTrace(1)
+    for i in range(tracing._MAX_SPANS + 5):
+        tr.add_span("decode_step", 0.0, 0.1)
+    assert len(tr.spans) == tracing._MAX_SPANS
+    assert tr.dropped_spans == 5
+
+
+def test_trace_store_tail_retention_keeps_slowest_and_errors():
+    from mxnet_tpu.serving.tracing import RequestTrace, TraceStore
+
+    store = TraceStore(keep_slowest=2, keep_recent=3, keep_errors=4)
+
+    def finished(i, dur, outcome="length", error=None, evicted=False):
+        tr = RequestTrace(i)
+        tr.t_end = tr.t0 + dur  # fix duration deterministically
+        tr.outcome = outcome
+        tr.error = error
+        tr.evicted = evicted
+        store.add(tr)
+        return tr
+
+    slow = finished(1, 9.0)                      # the p99 outlier, early
+    err = finished(2, 0.1, outcome="error",
+                   error=RuntimeError("boom"))
+    ev = finished(3, 0.2, evicted=True)
+    for i in range(4, 30):                       # healthy fast traffic
+        finished(i, 0.01)
+    kept = {tr.trace_id: tags for tr, tags in store.traces()}
+    # the slowest trace survived 26 later completions
+    assert 1 in kept and "slowest" in kept[1]
+    # error + evicted traces are always retained
+    assert 2 in kept and "errors" in kept[2]
+    assert 3 in kept and "errors" in kept[3]
+    # the recent ring holds only the newest 3
+    assert all("recent" not in tags for tid, tags in kept.items()
+               if tid < 27)
+    snap = store.snapshot()
+    assert snap["traced_requests"] == 29
+    assert snap["requests"][0]["trace_id"] == 1  # slowest-first
+    assert snap["retention"]["keep_slowest"] == 2
+    json.dumps(snap)
+    assert slow.duration_s == pytest.approx(9.0)
+    assert err.error is not None and ev.evicted
+
+
+def _tiny_train_step():
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    net = nn.Dense(2)
+    net.initialize()
+    net(nd.ones((1, 3)))   # resolve deferred shapes before functionalize
+
+    def loss_fn(out, y):
+        import jax.numpy as jnp
+
+        return jnp.square(out - y).mean()
+
+    return TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.01})
+
+
+def _drop_mfu_gauge():
+    from mxnet_tpu import introspection
+
+    telemetry._FAMILIES.pop("mxnet_model_flops_utilization", None)
+    introspection._MFU_GAUGE = None
+    introspection.reset()
+
+
+def test_online_mfu_gauge_present_with_peak_override(monkeypatch):
+    from mxnet_tpu import introspection
+
+    _drop_mfu_gauge()
+    monkeypatch.setenv("MXNET_DEVICE_PEAK_FLOPS", "1e12")
+    step = _tiny_train_step()
+    x = np.ones((4, 3), "f")
+    y = np.zeros((4, 2), "f")
+    for _ in range(3):
+        np.asarray(step(x, y))
+    ws = introspection.window_stats()
+    assert ws["events"] == 3 and ws["flops"] > 0
+    snap = telemetry.snapshot()
+    assert "mxnet_model_flops_utilization" in snap["metrics"]
+    util = snap["metrics"]["mxnet_model_flops_utilization"][
+        "samples"][0]["value"]
+    assert util > 0
+    fl = snap["metrics"]["mxnet_executable_flops_total"]["samples"]
+    assert {"kind": "train_step"} in [s["labels"] for s in fl]
+    # exactly ONE train_step compile event: the AOT path traced once
+    kinds = [e["kind"] for e in snap["compile_events"]]
+    assert kinds.count("train_step") == 1
+
+
+def test_mfu_gauge_absent_when_cost_analysis_unavailable(monkeypatch):
+    """The graceful-fallback contract: no FLOPs source -> the MFU gauge
+    does not exist (absent, not wrong) — and the step still runs."""
+    from mxnet_tpu import introspection
+
+    _drop_mfu_gauge()
+    monkeypatch.setenv("MXNET_DEVICE_PEAK_FLOPS", "1e12")
+    monkeypatch.setattr(introspection, "flops_of", lambda compiled: None)
+    step = _tiny_train_step()
+    losses = [np.asarray(step(np.ones((4, 3), "f"),
+                              np.zeros((4, 2), "f")))
+              for _ in range(2)]
+    assert all(np.isfinite(v) for v in losses)
+    assert introspection.window_stats()["events"] == 0
+    assert "mxnet_model_flops_utilization" not in \
+        telemetry.snapshot()["metrics"]
+
+
+def test_mfu_gauge_absent_when_peak_unknown(monkeypatch):
+    from mxnet_tpu import introspection
+
+    _drop_mfu_gauge()
+    monkeypatch.delenv("MXNET_DEVICE_PEAK_FLOPS", raising=False)
+    monkeypatch.setattr(introspection, "device_peak_flops", lambda: None)
+    introspection.account_flops(1e9)
+    introspection.account_flops(1e9)
+    assert introspection.utilization() is None
+    assert "mxnet_model_flops_utilization" not in \
+        telemetry.snapshot()["metrics"]
+
+
+def test_aot_flops_match_cost_analysis_source():
+    """Online accounting uses the SAME FLOPs source as an offline
+    lower().compile().cost_analysis() of the identical step — the
+    bench extra.observability MFU pin relies on this equivalence."""
+    from mxnet_tpu import introspection
+
+    introspection.reset()
+    step = _tiny_train_step()
+    x = np.ones((4, 3), "f")
+    y = np.zeros((4, 2), "f")
+    np.asarray(step(x, y))
+    per_step = telemetry.snapshot()["metrics"][
+        "mxnet_executable_flops_total"]["samples"][0]["value"]
+    compiled, flops = step._compiled[next(iter(step._compiled))][0]
+    assert flops == pytest.approx(per_step)
+    assert introspection.flops_of(compiled) == pytest.approx(per_step)
+
+
+def test_goodput_ledger_preempt_resume_and_reshard(tmp_path):
+    """Goodput classification across a restarting run and a live
+    reshard: productive accrues from steps, checkpoint from save,
+    restart from the failure->re-attempt window, reshard from the
+    transfer seam; the ratio reflects all of them."""
+    import time as _time
+
+    from mxnet_tpu.checkpoint import CheckpointManager, run_with_recovery
+    from mxnet_tpu.parallel import resharding
+
+    manager = CheckpointManager(str(tmp_path))
+    state = {"fails": 0}
+
+    def train_fn(start, mgr):
+        for s in range(start, 3):
+            with telemetry.step_scope(s):
+                _time.sleep(0.002)
+            mgr.save(s)
+        if state["fails"] < 1:
+            state["fails"] += 1
+            raise RuntimeError("injected failure")
+        return "done"
+
+    assert run_with_recovery(train_fn, manager, max_restarts=3,
+                             backoff_ms=1) == "done"
+    # a live transfer (trivial 1-device plans) charges the reshard bucket
+    resharding.transfer_params({"w": np.ones((4, 4), "f")})
+    good = telemetry.goodput_summary()
+    for bucket in ("productive", "checkpoint", "restart", "reshard"):
+        assert good["buckets"].get(bucket, 0) > 0, (bucket, good)
+    assert 0 < good["productive_ratio"] < 1
+    snap = telemetry.snapshot()
+    assert snap["goodput"]["buckets"] == good["buckets"]
+    ratio = snap["metrics"]["mxnet_goodput_ratio"]["samples"][0]["value"]
+    assert ratio == pytest.approx(good["productive_ratio"])
+
+
+def test_goodput_stall_bucket_from_watchdog(tmp_path):
+    from mxnet_tpu import lifecycle
+
+    telemetry.heartbeat()
+    wd = lifecycle.Watchdog(timeout_s=0.01, abort=False,
+                            dump_dir=str(tmp_path), poll_s=0.005)
+    wd._fire(1.25, None)   # a REAL stall fire charges the ledger
+    assert telemetry.goodput_summary()["buckets"]["stall"] == \
+        pytest.approx(1.25)
+    wd._fire(9.9, RuntimeError("chaos"))  # injected fires charge nothing
+    assert telemetry.goodput_summary()["buckets"]["stall"] == \
+        pytest.approx(1.25)
+
+
+def _synthetic_snapshot(step, phases, steps_total):
+    return {
+        "time": 100.0 + steps_total,
+        "metrics": {
+            "mxnet_steps_total": {
+                "type": "counter", "help": "h",
+                "samples": [{"labels": {}, "value": steps_total}]},
+        },
+        "steps": [{"step": step, "time": 100.0, "wall_s": sum(
+            phases.values()), "phases": dict(phases)}],
+        "compile": {"count": 2},
+        "goodput": {"productive_ratio": 0.5},
+    }
+
+
+def test_rank_merge_is_deterministic_and_rank_labeled():
+    from mxnet_tpu import telemetry_agg
+
+    s0 = _synthetic_snapshot(5, {"data": 0.010, "forward_backward": 0.02},
+                             6)
+    s1 = _synthetic_snapshot(5, {"data": 0.025, "forward_backward": 0.02},
+                             6)
+    m1 = telemetry_agg.merge_snapshots({0: s0, 1: s1})
+    m2 = telemetry_agg.merge_snapshots({1: s1, 0: s0})
+    assert json.dumps(m1, sort_keys=True) == json.dumps(m2,
+                                                        sort_keys=True)
+    assert m1["ranks"] == [0, 1]
+    labels = [s["labels"] for s in
+              m1["metrics"]["mxnet_steps_total"]["samples"]]
+    assert labels == [{"rank": "0"}, {"rank": "1"}]
+    assert m1["skew"]["step"] == 5
+    assert m1["skew"]["phases"]["data"] == pytest.approx(0.015)
+    assert m1["skew"]["phases"]["forward_backward"] == pytest.approx(0.0)
+    assert m1["per_rank"][0]["last_step"] == 5
+    assert m1["per_rank"][1]["compile_count"] == 2
+    # no common step -> no skew, never a crash
+    s2 = _synthetic_snapshot(9, {"data": 0.01}, 1)
+    m3 = telemetry_agg.merge_snapshots({0: s0, 1: s2})
+    assert m3["skew"]["step"] is None and m3["skew"]["phases"] == {}
+
+
+def test_aggregator_dir_roundtrip_and_skew_histogram(tmp_path):
+    from mxnet_tpu import telemetry_agg
+
+    telemetry_agg.reset()
+    try:
+        with telemetry.step_scope(3):
+            pass
+        assert telemetry_agg.publish(str(tmp_path), 0)
+        # fabricate a slower peer at the same step
+        peer = telemetry.snapshot()
+        peer["steps"][-1]["phases"]["other"] = \
+            peer["steps"][-1]["phases"].get("other", 0.0) + 0.5
+        with open(tmp_path / "rank1.json", "w") as f:
+            json.dump(peer, f)
+        (tmp_path / "rank9.json").write_text("{torn")  # skipped, not fatal
+        doc = telemetry_agg.merge_dir(str(tmp_path))
+        assert doc["ranks"] == [0, 1]
+        assert doc["skew"]["step"] == 3
+        hist = telemetry.snapshot()["metrics"][
+            "mxnet_rank_step_skew_seconds"]
+        assert any(s["count"] for s in hist["samples"])
+    finally:
+        telemetry_agg.reset()
+
+
+def test_aggregator_tick_stride(tmp_path, monkeypatch):
+    from mxnet_tpu import telemetry_agg
+
+    telemetry_agg.reset()
+    try:
+        telemetry_agg.configure(directory=str(tmp_path), every=2, rank=0,
+                                world=1)
+        for i in range(4):
+            with telemetry.step_scope(i):   # step_end ticks the stride
+                pass
+        merged = telemetry_agg.merged()
+        assert merged is not None and merged["ranks"] == [0]
+        assert (tmp_path / "rank0.json").exists()
+    finally:
+        telemetry_agg.reset()
+
+
+def test_compile_cache_entry_carries_flops(tmp_path):
+    from mxnet_tpu.compile_cache import CompileCache
+
+    cache = CompileCache(str(tmp_path))
+    key = cache.key("t", ("sig",))
+    assert cache.put_bytes(key, b"payload", meta={"flops": 123.0})
+    payload, meta = cache.get_entry(key)
+    assert payload == b"payload" and meta == {"flops": 123.0}
+    # load_executable_entry on a miss is (None, {})
+    fn, meta2 = cache.load_executable_entry(cache.key("t", ("other",)))
+    assert fn is None and meta2 == {}
+
+
+def test_read_dir_drops_stale_departed_ranks(tmp_path):
+    """A rank that left an elastic job stops publishing; its file must
+    not pin a frozen rank into every merge forever.  Staleness is
+    judged against the NEWEST file, not the wall clock, so offline
+    re-merges of old directories stay deterministic and complete."""
+    from mxnet_tpu import telemetry_agg
+
+    fresh = _synthetic_snapshot(5, {"data": 0.01}, 6)
+    fresh["time"] = 10_000.0
+    stale = _synthetic_snapshot(2, {"data": 0.01}, 3)
+    stale["time"] = 10_000.0 - 3600.0      # an hour behind the newest
+    with open(tmp_path / "rank0.json", "w") as f:
+        json.dump(fresh, f)
+    with open(tmp_path / "rank3.json", "w") as f:
+        json.dump(stale, f)
+    assert sorted(telemetry_agg.read_dir(str(tmp_path))) == [0]
+    # filter disabled / both within the window -> both merge
+    assert sorted(telemetry_agg.read_dir(str(tmp_path),
+                                         max_age_s=0)) == [0, 3]
+    assert sorted(telemetry_agg.read_dir(str(tmp_path),
+                                         max_age_s=7200)) == [0, 3]
+
+
+def test_request_trace_event_cap_keeps_flags():
+    from mxnet_tpu.serving import tracing
+    from mxnet_tpu.serving.tracing import RequestTrace
+
+    tr = RequestTrace(2)
+    for _ in range(tracing._MAX_EVENTS + 3):
+        tr.event("requeued", reason="pool_full")
+    tr.event("evicted")   # past the cap: dropped but the flag still set
+    assert len(tr.events) == tracing._MAX_EVENTS
+    assert tr.dropped_events == 4
+    assert tr.evicted is True
+    assert tr.to_dict()["dropped_events"] == 4

@@ -1,0 +1,156 @@
+"""MXT090/091: the ``mxnet_*`` metric catalog must stay closed.
+
+README's "Observability" section carries the **Metric catalog** table —
+the operator-facing registry of every telemetry family the library can
+emit.  PRs 4-13 each added families (compile-cache, reshard,
+checkpoint, bucket-allreduce, ...) and the catalog silently drifted;
+this pass closes it both ways, exactly like MXT030-032 close the
+env-knob registry:
+
+- **MXT090** — a metric family registered in code (a literal first
+  argument to ``telemetry.counter/gauge/histogram`` — receiver-alias
+  agnostic — or a collector family dict carrying ``name`` + ``samples``)
+  that has no README catalog row.
+- **MXT091** — a catalog row naming a family nothing in
+  ``mxnet_tpu/`` registers (dead documentation).
+
+Dynamic names are handled as patterns: an f-string registration
+(``f"mxnet_fault_seam_{metric}_total"``) matches any catalog row its
+literal parts admit, and MXT090 fires only when NO row matches.  The
+catalog row grammar (implied ``mxnet_`` prefix, inner ``{a,b}``
+alternation, trailing ``{label}`` annotation) lives in
+``repo.expand_metric_token``.  A README with no ``**Metric catalog**``
+marker leaves the pass inert (fixture mini-repos); registrations are
+only collected from ``mxnet_tpu/`` so tests asserting on family names
+never count as registrations.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import call_name
+from ..core import Finding, Pass, register
+from ..repo import _METRIC_NAME
+
+# a registration is a call to one of these (last dotted component, so
+# telemetry.counter / _telemetry.gauge / _tel.histogram / the local
+# collector-family helper `fam` all resolve)
+_REG_CALLEES = {"counter", "gauge", "histogram", "fam"}
+
+
+def _literal_or_pattern(node):
+    """``(exact_name, None)`` / ``(None, regex)`` / ``(None, None)``
+    for a registration-name argument node."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if _METRIC_NAME.match(node.value):
+            return node.value, None
+        return None, None
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(re.escape(v.value))
+            else:
+                parts.append("[a-z0-9_]+")
+        pat = "".join(parts)
+        if pat.startswith(re.escape("mxnet_")):
+            return None, "^" + pat + "$"
+    return None, None
+
+
+def _registration_name_nodes(node):
+    """Name-argument nodes of one AST node, if it registers a family."""
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        callee = name.rsplit(".", 1)[-1] if name else None
+        if callee in _REG_CALLEES and node.args:
+            return [node.args[0]]
+    elif isinstance(node, ast.Dict):
+        # collector output shape: {"name": ..., "type": ..., "samples":
+        # ...} — the samples key distinguishes a metric family dict
+        # from any other {"name": ...} literal (ONNX graphs etc.)
+        keys = {k.value for k in node.keys
+                if isinstance(k, ast.Constant)}
+        if "name" in keys and "samples" in keys:
+            return [v for k, v in zip(node.keys, node.values)
+                    if isinstance(k, ast.Constant) and k.value == "name"]
+    return []
+
+
+@register
+class MetricRegistry(Pass):
+    name = "metric-registry"
+    codes = {
+        "MXT090": "registered metric family missing from README catalog",
+        "MXT091": "README catalog row matches no metric registration",
+    }
+
+    def __init__(self):
+        self._exact = {}      # name -> first (path, line, scope)
+        self._patterns = {}   # regex -> first (path, line, scope)
+
+    def run(self, ctx, mod):
+        findings = []
+        if not mod.relpath.startswith("mxnet_tpu/"):
+            return findings
+        registry = ctx.repo.readme_metrics
+        for node in ast.walk(mod.tree):
+            for arg in _registration_name_nodes(node):
+                exact, pattern = _literal_or_pattern(arg)
+                if exact is not None:
+                    self._exact.setdefault(
+                        exact, (mod.relpath, arg.lineno,
+                                mod.qualname(arg)))
+                    if registry["has_catalog"] and \
+                            exact not in registry["names"]:
+                        findings.append(Finding(
+                            code="MXT090", path=mod.relpath,
+                            line=arg.lineno,
+                            message=f"metric family {exact!r} is "
+                                    "registered here but has no README "
+                                    "Metric-catalog row",
+                            hint="add a row to README's Observability "
+                                 "metric catalog (operators discover "
+                                 "families there, not by scraping)",
+                            scope=mod.qualname(arg),
+                            key=f"uncataloged:{exact}"))
+                elif pattern is not None:
+                    self._patterns.setdefault(
+                        pattern, (mod.relpath, arg.lineno,
+                                  mod.qualname(arg)))
+        return findings
+
+    def finalize(self, ctx):
+        findings = []
+        registry = ctx.repo.readme_metrics
+        if not registry["has_catalog"]:
+            return findings
+        catalog = registry["names"]
+        for pattern, (path, line, scope) in sorted(
+                self._patterns.items()):
+            rx = re.compile(pattern)
+            if not any(rx.match(n) for n in catalog):
+                findings.append(Finding(
+                    code="MXT090", path=path, line=line,
+                    message=f"dynamically-named metric family "
+                            f"(pattern {pattern}) has no matching "
+                            "README catalog row",
+                    hint="add a row covering the expansion (the "
+                         "{a,b} alternation syntax documents the "
+                         "dynamic part)",
+                    scope=scope, key=f"uncataloged-pattern:{pattern}"))
+        pats = [re.compile(p) for p in self._patterns]
+        for name, line in sorted(catalog.items()):
+            if name in self._exact:
+                continue
+            if any(rx.match(name) for rx in pats):
+                continue
+            findings.append(Finding(
+                code="MXT091", path=registry["path"], line=line,
+                message=f"README catalog row {name!r} matches no "
+                        "metric registration in mxnet_tpu/",
+                hint="delete the row or fix the name — a dead catalog "
+                     "row misdocuments the scrape surface",
+                scope="<catalog>", key=f"dead-row:{name}"))
+        return findings

@@ -12,6 +12,32 @@ import os
 import re
 
 _MXNET_NAME = re.compile(r"^MXNET_[A-Z0-9_]+$")
+_METRIC_NAME = re.compile(r"^mxnet_[a-z0-9_]+$")
+
+
+def expand_metric_token(tok):
+    """Expand one catalog-cell token into full family names: drop a
+    TRAILING ``{labels}`` group, expand inner ``{a,b}`` alternation,
+    imply the ``mxnet_`` prefix.  Tokens that expand to nothing metric-
+    shaped (prose in backticks) yield []."""
+    tok = re.sub(r"\{[^{}]*\}$", "", tok.strip())
+
+    def expand(s):
+        m = re.search(r"\{([^{}]*)\}", s)
+        if not m:
+            return [s]
+        out = []
+        for alt in m.group(1).split(","):
+            out.extend(expand(s[:m.start()] + alt.strip() + s[m.end():]))
+        return out
+
+    names = []
+    for name in expand(tok):
+        if not name.startswith("mxnet_"):
+            name = "mxnet_" + name
+        if _METRIC_NAME.match(name):
+            names.append(name)
+    return names
 
 
 class RepoModel:
@@ -22,6 +48,7 @@ class RepoModel:
         self._env = None
         self._seams = None
         self._readme = None
+        self._metrics = None
 
     # -- env knob registry (mxnet_tpu/env.py) ------------------------------
     def _load_env(self):
@@ -97,6 +124,50 @@ class RepoModel:
                                     isinstance(elt.value, str):
                                 self._seams.add(elt.value)
         return self._seams
+
+    # -- README metric catalog ---------------------------------------------
+    @property
+    def readme_metrics(self):
+        """``{"names": {family: line}, "path", "has_catalog"}`` — the
+        families documented in README's "Metric catalog" table (the
+        markdown table following the ``**Metric catalog**`` marker).
+
+        Row format contract (the metric-registry pass's parse target):
+        backticked tokens in the FIRST column are family names, the
+        ``mxnet_`` prefix implied; an inner ``{a,b}`` group expands by
+        alternation (``kvstore_{push,pull}_bytes_total``); a trailing
+        ``{label,...}`` group annotates labels and is dropped.  With no
+        marker present ``has_catalog`` is False and the pass is inert
+        (mini fixture repos)."""
+        if self._metrics is None:
+            names, has_catalog = {}, False
+            path = os.path.join(self.root, "README.md")
+            if os.path.exists(path):
+                with open(path, encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+                in_table = False
+                seen_marker = False
+                for lineno, line in enumerate(lines, 1):
+                    if "**Metric catalog**" in line:
+                        seen_marker, has_catalog = True, True
+                        continue
+                    stripped = line.lstrip()
+                    if seen_marker and not in_table:
+                        if stripped.startswith("|"):
+                            in_table = True
+                        continue
+                    if in_table:
+                        if not stripped.startswith("|"):
+                            in_table = seen_marker = False
+                            continue
+                        cells = stripped.split("|")
+                        first = cells[1] if len(cells) > 1 else ""
+                        for tok in re.findall(r"`([^`]+)`", first):
+                            for name in expand_metric_token(tok):
+                                names.setdefault(name, lineno)
+            self._metrics = {"names": names, "path": "README.md",
+                             "has_catalog": has_catalog}
+        return self._metrics
 
     # -- README knob mentions ----------------------------------------------
     @property

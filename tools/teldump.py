@@ -1,0 +1,190 @@
+"""teldump: pretty-print, merge, and diff telemetry snapshots.
+
+The operator-side half of the runtime introspection plane (ISSUE 14).
+A snapshot is the JSON ``telemetry.snapshot()`` produces — from the
+``/snapshot`` HTTP route, a watchdog stall dump's ``telemetry`` field,
+a ``rank<N>.json`` aggregation file, or a merged ``/agg`` document.
+
+Usage::
+
+    python -m tools.teldump show snap.json [--metrics PREFIX]
+    python -m tools.teldump diff before.json after.json
+    python -m tools.teldump agg  /path/to/agg_dir   # offline re-merge
+
+``show`` prints the metric families (counters/gauges as values,
+histograms as count/sum/mean), the step-phase breakdown, the goodput
+ledger, and the compile summary.  ``diff`` prints counter/gauge deltas
+and step-rate change between two snapshots of the SAME process (the
+"what changed across this incident" view).  ``agg`` re-runs the pure
+:func:`mxnet_tpu.telemetry_agg.merge_snapshots` over a directory of
+rank files and prints the per-rank summary + straggler skew — the
+same merge the live aggregator serves at ``/agg``, reproducible
+offline because the merge is deterministic.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load(path):
+    with open(path) as f:
+        doc = json.load(f)
+    # accept a watchdog stall dump transparently
+    if "telemetry" in doc and "metrics" not in doc:
+        return doc["telemetry"]
+    return doc
+
+
+def _fmt_labels(labels):
+    labels = {k: v for k, v in (labels or {}).items()}
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) \
+        + "}"
+
+
+def _sample_rows(name, fam):
+    rows = []
+    for s in fam.get("samples", ()):
+        lab = _fmt_labels(s.get("labels"))
+        if "buckets" in s:
+            count = s.get("count", 0)
+            total = s.get("sum", 0.0)
+            mean = (total / count) if count else 0.0
+            rows.append((name + lab,
+                         f"count={count} sum={total:.6g} "
+                         f"mean={mean:.6g}"))
+        else:
+            rows.append((name + lab, f"{s.get('value', 0):.6g}"))
+    return rows
+
+
+def cmd_show(args):
+    snap = _load(args.snapshot)
+    rows = []
+    for name in sorted(snap.get("metrics") or {}):
+        if args.metrics and not name.startswith(args.metrics):
+            continue
+        rows.extend(_sample_rows(name, snap["metrics"][name]))
+    width = max((len(r[0]) for r in rows), default=20)
+    print(f"# {args.snapshot}: {len(rows)} series")
+    for key, val in rows:
+        print(f"  {key:<{width}}  {val}")
+    phases = snap.get("step_phase_totals") or {}
+    if phases:
+        total = sum(phases.values()) or 1.0
+        print(f"\n# step phases ({len(snap.get('steps') or [])} steps "
+              "in ring)")
+        for name, dt in sorted(phases.items(), key=lambda p: -p[1]):
+            print(f"  {name:<20} {dt:10.4f}s  {100 * dt / total:5.1f}%")
+    good = snap.get("goodput") or {}
+    if good.get("tracked_s"):
+        print("\n# goodput")
+        for bucket, dt in sorted((good.get("buckets") or {}).items(),
+                                 key=lambda p: -p[1]):
+            print(f"  {bucket:<20} {dt:10.4f}s")
+        ratio = good.get("productive_ratio")
+        if ratio is not None:
+            print(f"  {'ratio':<20} {ratio:10.4f}")
+    comp = snap.get("compile") or {}
+    if comp:
+        print(f"\n# compile: {comp.get('count', 0)} events, "
+              f"{comp.get('total_s', 0):.3f}s total")
+    return 0
+
+
+def _scalars(snap):
+    out = {}
+    for name, fam in (snap.get("metrics") or {}).items():
+        for s in fam.get("samples", ()):
+            key = name + _fmt_labels(s.get("labels"))
+            if "buckets" in s:
+                out[key + ":count"] = s.get("count", 0)
+                out[key + ":sum"] = s.get("sum", 0.0)
+            else:
+                out[key] = s.get("value", 0)
+    return out
+
+
+def cmd_diff(args):
+    a, b = _load(args.a), _load(args.b)
+    sa, sb = _scalars(a), _scalars(b)
+    keys = sorted(set(sa) | set(sb))
+    width = max((len(k) for k in keys), default=20)
+    n = 0
+    for key in keys:
+        va, vb = sa.get(key, 0), sb.get(key, 0)
+        if va == vb:
+            continue
+        n += 1
+        print(f"  {key:<{width}}  {va:.6g} -> {vb:.6g} "
+              f"({vb - va:+.6g})")
+    dt = (b.get("time") or 0) - (a.get("time") or 0)
+    print(f"# {n} series changed over {dt:.1f}s "
+          f"({args.a} -> {args.b})")
+    return 0
+
+
+def cmd_agg(args):
+    from mxnet_tpu import telemetry_agg
+
+    snaps = telemetry_agg.read_dir(args.directory)
+    if not snaps:
+        print(f"no rank*.json files in {args.directory}",
+              file=sys.stderr)
+        return 1
+    doc = telemetry_agg.merge_snapshots(snaps)
+    print(f"# merged ranks: {doc['ranks']}")
+    for rank in doc["ranks"]:
+        pr = doc["per_rank"][rank]
+        print(f"  rank {rank}: steps={pr['steps']} "
+              f"last_step={pr['last_step']} "
+              f"compiles={pr['compile_count']} "
+              f"goodput={pr['goodput_ratio']}")
+    skew = doc["skew"]
+    if skew["step"] is not None:
+        print(f"# phase skew at step {skew['step']} (max - min across "
+              "ranks)")
+        for phase, dt in sorted(skew["phases"].items(),
+                                key=lambda p: -p[1]):
+            print(f"  {phase:<20} {dt * 1e3:8.3f}ms")
+    else:
+        print("# no common step across ranks yet (no skew)")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f)
+        print(f"# merged document written to {args.out}")
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.teldump",
+        description="pretty-print / diff / merge telemetry snapshots")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_show = sub.add_parser("show", help="print one snapshot")
+    p_show.add_argument("snapshot")
+    p_show.add_argument("--metrics", default="",
+                        help="only families with this prefix")
+    p_show.set_defaults(fn=cmd_show)
+    p_diff = sub.add_parser("diff", help="counter/gauge deltas a -> b")
+    p_diff.add_argument("a")
+    p_diff.add_argument("b")
+    p_diff.set_defaults(fn=cmd_diff)
+    p_agg = sub.add_parser(
+        "agg", help="offline re-merge of an aggregation directory")
+    p_agg.add_argument("directory")
+    p_agg.add_argument("--out", default="",
+                       help="also write the merged JSON here")
+    p_agg.set_defaults(fn=cmd_agg)
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:   # | head must not traceback
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
