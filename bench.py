@@ -1240,6 +1240,62 @@ def bench_observability():
                                  0.75 <= mfu_ratio <= 1.35),
     }
     out["goodput"] = telemetry.goodput_summary()
+
+    # -- flight-recorder A/B (ISSUE 15): recorder-on vs
+    # MXNET_FLIGHT_RECORDER=0 within noise on eager µs/op AND serving
+    # tokens/s.  The recorder stamps only Python-level collective issue
+    # points + step boundaries — the eager dispatch path and the
+    # serving decode loop gain literally zero code — so any residual
+    # delta is scheduler noise (same arm-alternating discipline as the
+    # tracing A/B above).
+    from mxnet_tpu import flight_recorder
+
+    def _flight_env(flag):
+        prev = os.environ.get("MXNET_FLIGHT_RECORDER")
+        os.environ["MXNET_FLIGHT_RECORDER"] = flag
+        flight_recorder.reset()     # re-resolve the cached gate
+        return prev
+
+    def _flight_restore(prev):
+        if prev is None:
+            os.environ.pop("MXNET_FLIGHT_RECORDER", None)
+        else:
+            os.environ["MXNET_FLIGHT_RECORDER"] = prev
+        flight_recorder.reset()
+
+    def flight_eager(flag):
+        prev = _flight_env(flag)
+        try:
+            return min(bench_eager_op_overhead(
+                iters=150, warmup=20)["us_per_op_jit"]
+                for _ in range(2))
+        finally:
+            _flight_restore(prev)
+
+    def flight_serving(flag):
+        prev = _flight_env(flag)
+        try:
+            return serving_tokens_per_s(False)
+        finally:
+            _flight_restore(prev)
+
+    fe_on, fe_off = flight_eager("1"), flight_eager("0")
+    fs_on, fs_off = [], []
+    for _ in range(2):
+        fs_on.append(flight_serving("1"))
+        fs_off.append(flight_serving("0"))
+    fe_ratio = fe_on / fe_off if fe_off else 1.0
+    fs_ratio = max(fs_on) / max(fs_off) if max(fs_off) else 1.0
+    out["flight_overhead"] = {
+        "eager_us_recorder_on": fe_on,
+        "eager_us_recorder_off": fe_off,
+        "eager_ratio": round(fe_ratio, 3),
+        "serving_tokens_per_s_on": round(max(fs_on), 1),
+        "serving_tokens_per_s_off": round(max(fs_off), 1),
+        "serving_ratio": round(fs_ratio, 3),
+        "within_noise": bool(0.8 <= fe_ratio <= 1.25
+                             and fs_ratio >= 0.8),
+    }
     return out
 
 

@@ -1,0 +1,208 @@
+"""Black-box flight-recorder smoke (ISSUE 15 acceptance): a REAL
+2-process run where a SIGSTOP'd child yields a correct hang-blame
+verdict from the merged black boxes.
+
+Shape:
+
+1. Two real child processes (rank 0 / rank 1) run a lockstepped loop:
+   each step issues a real host-value allreduce
+   (``collectives.allreduce_hosts(_testing_force=True)`` — the stamped
+   production path) and then a file-based lockstep barrier wrapped in
+   its own ``flight_recorder.collective("lockstep")`` stamp, so the
+   two ranks advance their collective ledgers in sync exactly like
+   SPMD peers.  Each child runs the production watchdog
+   (``MXNET_WATCHDOG_TIMEOUT_S=3``) fed by the step heartbeat.
+2. The parent SIGSTOPs rank 0 mid-run — the freeze class a preempted /
+   wedged host exhibits.  Rank 1 blocks inside its lockstep collective
+   waiting for the frozen peer, its heartbeat goes stale, and its
+   watchdog fires: black-box dump (``blackbox.rank1.json`` into the
+   shared gather dir) + ``EXIT_STALLED``.
+3. The parent then drops a halt marker and SIGCONTs rank 0.  Resumed,
+   rank 0 parks (never advancing its ledger past where the freeze left
+   it — in production the wedged collective itself pins it there), its
+   own stale heartbeat trips its watchdog, and it dumps
+   ``blackbox.rank0.json`` + exits ``EXIT_STALLED``.
+4. The parent merges the two rings (``telemetry_agg.merge_blackboxes``)
+   and asserts the verdict: **hang, blaming rank 0**, with the wedged
+   collective's tag and sequence number — and that the offline
+   ``python -m tools.teldump blame`` re-merge bit-matches the live
+   verdict (the merge is pure, so it must).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+STEPS = 500
+
+
+# --------------------------------------------------------------------------
+# child
+# --------------------------------------------------------------------------
+def child_main(rank, workdir):
+    import numpy as np
+
+    from mxnet_tpu import flight_recorder, telemetry
+    from mxnet_tpu.parallel import collectives
+
+    peer = 1 - rank
+    halt = os.path.join(workdir, "halt")
+
+    def park():
+        # a halted rank must never advance its ledger again: in the
+        # real failure the wedged collective pins it here; the marker
+        # reproduces that determinism for the smoke.  No heartbeat →
+        # this rank's own watchdog diagnoses + dumps + aborts.
+        while True:
+            time.sleep(0.05)
+
+    # warmup OUTSIDE the stepped loop: the first host-combine jit
+    # compile rides the watchdog's 10x pre-first-heartbeat allowance
+    collectives.allreduce_hosts(np.ones(64, np.float32),
+                                _testing_force=True)
+    for i in range(1, STEPS + 1):
+        if os.path.exists(halt):
+            park()
+        telemetry.step_begin()
+        collectives.allreduce_hosts(np.full(64, float(i), np.float32),
+                                    _testing_force=True)
+        # lockstep barrier: write mine, wait for the peer's — wrapped
+        # in its own ledger stamp so a rank frozen while a peer waits
+        # shows up exactly like a wedged device collective
+        open(os.path.join(workdir, f"step.{rank}.{i}"), "w").close()
+        with flight_recorder.collective("lockstep", generation=i):
+            while not os.path.exists(
+                    os.path.join(workdir, f"step.{peer}.{i}")):
+                if os.path.exists(halt):
+                    park()
+                time.sleep(0.02)
+        telemetry.step_end()
+        time.sleep(0.03)
+    print(f"rank {rank}: completed all {STEPS} steps (unexpected)",
+          flush=True)
+    sys.exit(0)
+
+
+# --------------------------------------------------------------------------
+# parent
+# --------------------------------------------------------------------------
+def _spawn(rank, workdir):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        MXNET_WORKER_ID=str(rank),
+        MXNET_NUM_WORKERS="2",
+        MXNET_TELEMETRY_AGG_DIR=workdir,
+        MXNET_WATCHDOG_TIMEOUT_S="3",
+        MXNET_WATCHDOG_ABORT="1",
+        MXNET_WATCHDOG_DIR=workdir,
+    )
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         str(rank), workdir],
+        cwd=REPO_ROOT, env=env)
+
+
+def _wait_for(cond, timeout, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _wait_exit(proc, timeout, what):
+    try:
+        rc = proc.wait(timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise AssertionError(f"{what} did not exit in {timeout}s")
+    return rc
+
+
+def main():
+    import tempfile
+
+    from mxnet_tpu import lifecycle, telemetry_agg
+
+    workdir = tempfile.mkdtemp(prefix="mxnet_blackbox_smoke_")
+    print(f"blackbox smoke: workdir {workdir}", flush=True)
+    c0 = _spawn(0, workdir)
+    c1 = _spawn(1, workdir)
+    try:
+        # let both ranks advance a few lockstepped steps
+        _wait_for(lambda: all(
+            os.path.exists(os.path.join(workdir, f"step.{r}.5"))
+            for r in (0, 1)), 120, "both ranks reaching step 5")
+        # freeze rank 0 (the SIGSTOP class: a wedged/preempted host)
+        os.kill(c0.pid, signal.SIGSTOP)
+        print("rank 0 SIGSTOPped; waiting for rank 1's watchdog",
+              flush=True)
+        rc1 = _wait_exit(c1, 120, "rank 1 (survivor)")
+        assert rc1 == lifecycle.EXIT_STALLED, \
+            f"survivor exit {rc1} != EXIT_STALLED"
+        assert os.path.exists(
+            os.path.join(workdir, "blackbox.rank1.json")), \
+            "survivor wrote no black box"
+        # resume rank 0 under the halt marker: it parks, its own
+        # watchdog diagnoses the stale heartbeat and dumps its ring
+        open(os.path.join(workdir, "halt"), "w").close()
+        os.kill(c0.pid, signal.SIGCONT)
+        rc0 = _wait_exit(c0, 120, "rank 0 (frozen)")
+        assert rc0 == lifecycle.EXIT_STALLED, \
+            f"frozen rank exit {rc0} != EXIT_STALLED"
+
+        # -- the merged blame verdict ---------------------------------
+        boxes = telemetry_agg.read_blackboxes(workdir)
+        assert sorted(boxes) == [0, 1], f"boxes: {sorted(boxes)}"
+        assert boxes[1]["reason"] == "watchdog_stall"
+        doc = telemetry_agg.merge_blackboxes(boxes)
+        v = doc["verdict"]
+        print(f"verdict: {v['kind']} ranks={v['ranks']} seq={v['seq']} "
+              f"tag={v['tag']}", flush=True)
+        print(f"  {v['detail']}", flush=True)
+        assert v["kind"] == "hang", v
+        assert v["ranks"] == [0], f"blamed {v['ranks']}, expected [0]"
+        assert v["seq"] is not None and v["tag"], v
+        p0, p1 = doc["per_rank"][0], doc["per_rank"][1]
+        assert p0["last_seq"] < p1["last_seq"], (p0, p1)
+        # rank 1 must be wedged INSIDE its lockstep collective
+        assert p1["last_tag"].startswith("lockstep") \
+            and not p1["last_exited"], p1
+
+        # -- offline teldump re-merge bit-matches the live verdict ----
+        out = os.path.join(workdir, "blame.json")
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.teldump", "blame", workdir,
+             "--out", out],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 0, r.stderr
+        assert "HANG" in r.stdout, r.stdout
+        with open(out) as f:
+            offline = json.load(f)
+        assert json.dumps(offline, sort_keys=True) == \
+            json.dumps(doc, sort_keys=True), \
+            "offline re-merge diverged from the live verdict"
+        print("blackbox smoke: PASS", flush=True)
+    finally:
+        for proc in (c0, c1):
+            try:
+                os.kill(proc.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_main(int(sys.argv[2]), sys.argv[3])
+    else:
+        main()

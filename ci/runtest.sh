@@ -14,7 +14,9 @@
 #               -> stack-dump diagnosis + abort) + elasticity smoke
 #               (real child shrinks dp=4->2 mid-run and reshards LIVE,
 #               bit-identical; warm restart performs zero fresh traces
-#               and beats cold restart-to-first-step)
+#               and beats cold restart-to-first-step) + black-box
+#               flight-recorder smoke (SIGSTOP'd child -> merged
+#               hang-blame verdict naming the wedged collective)
 #   telemetry   runtime-telemetry smoke (train loop with telemetry +
 #               profiler on; Prometheus/snapshot/compile-event checks)
 #               + the telemetry unit suite
@@ -104,7 +106,13 @@ case "$LANE" in
     #    shared compile cache performs ZERO fresh traces and beats the
     #    cold restart-to-first-step
     JAX_PLATFORMS=cpu python ci/elastic_smoke.py
-    # 4) the fault suite incl. slow scenarios (real SIGKILL of a worker).
+    # 4) distributed flight recorder (ISSUE 15): a real 2-process run
+    #    where a SIGSTOP'd child must yield a correct hang-blame
+    #    verdict from the merged black-box rings — naming the wedged
+    #    collective tag, sequence number, and the frozen rank — with
+    #    the offline `teldump blame` re-merge bit-matching the live one
+    JAX_PLATFORMS=cpu python ci/blackbox_smoke.py
+    # 5) the fault suite incl. slow scenarios (real SIGKILL of a worker).
     #    The unit lane also runs this file; the repeat is deliberate —
     #    the chaos stage must stay green/triagable on its own (ISSUE 2)
     #    and is cheap (~20s).  test_checkpoint.py is NOT repeated.
@@ -114,11 +122,13 @@ case "$LANE" in
     # 1) end-to-end smoke through the PUBLIC surface (estimator-style
     #    loop, Trainer(telemetry=True), live HTTP scrape)
     JAX_PLATFORMS=cpu python ci/telemetry_smoke.py
-    # 2) the unit suite (registry concurrency, bucketing, exporters).
-    #    The unit lane also runs this file; the repeat is deliberate —
-    #    the telemetry stage must stay green/triagable on its own and is
-    #    cheap (~5s)
-    JAX_PLATFORMS=cpu python -m pytest -q tests/test_telemetry.py
+    # 2) the unit suites (registry concurrency, bucketing, exporters;
+    #    flight-recorder ring/blame/SLO/KV-transport).  The unit lane
+    #    also runs these files; the repeat is deliberate — the
+    #    telemetry stage must stay green/triagable on its own and is
+    #    cheap (~10s)
+    JAX_PLATFORMS=cpu python -m pytest -q tests/test_telemetry.py \
+      tests/test_flight.py
     ;;
   overlap)
     # 1) end-to-end smoke through the PUBLIC surface: 5-step loop with

@@ -77,6 +77,7 @@ from . import numpy_extension as npx
 from . import env
 from . import fault
 from . import telemetry
+from . import flight_recorder
 from . import lifecycle
 
 env.apply_env()

@@ -43,6 +43,7 @@ import threading
 import time
 
 from . import fault
+from . import flight_recorder as _flight
 from . import telemetry
 from .base import MXNetError
 
@@ -731,6 +732,14 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
             if should_retry is not None and not should_retry(e):
                 raise
             fail_t = time.perf_counter()
+            # black-box first, while the ring still holds the failing
+            # step's collectives: the dump is atomic and per-rank (the
+            # mesh may be mid-desync — NEVER a collective here), and a
+            # later successful recovery simply leaves the newest
+            # abnormal event on record
+            _flight.record_event("lifecycle", event="train_failure",
+                                 error=repr(e)[:200])
+            _flight.dump_blackbox("run_with_recovery_failure")
             # a background checkpoint write may still be in flight from
             # before the failure: let it finish (it may publish the step
             # that resets the budget) before judging progress — a FAILED
@@ -805,7 +814,10 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
                 restarts = 0
             restarts += 1
             _RESTARTS_TOTAL.inc()
+            _flight.record_event("lifecycle", event="restart",
+                                 attempt=restarts, step=effective)
             if restarts > max_restarts:
+                _flight.dump_blackbox("restart_budget_exhausted")
                 raise MXNetError(
                     f"training failed after {max_restarts} restarts "
                     f"without progress (stuck at step "

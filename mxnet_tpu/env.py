@@ -65,6 +65,29 @@ Wired vars (read at ``import mxnet_tpu``):
   :mod:`mxnet_tpu.telemetry_agg`).
 - ``MXNET_TELEMETRY_AGG_DIR``: the shared directory those per-rank
   snapshot files live in (unset = aggregation off).
+- ``MXNET_TELEMETRY_AGG_TRANSPORT``: snapshot-gather transport for the
+  cross-rank aggregator — ``file`` (default; the shared-directory
+  gather above) or ``kv`` (the jax.distributed KV store, for pods
+  without a shared filesystem).  Black-box crash dumps stay file-based
+  either way: the distributed runtime is presumed dead when they are
+  written.
+- ``MXNET_FLIGHT_RECORDER``: the distributed flight recorder — an
+  always-on preallocated ring stamping every collective issue site
+  with a per-rank sequence number + tag digest, plus step/fault/
+  compile/lifecycle context events (default 1; see
+  :mod:`mxnet_tpu.flight_recorder` and README "Observability").
+- ``MXNET_FLIGHT_RECORDER_CAP``: flight-recorder ring capacity in
+  events (default 4096).
+- ``MXNET_FLIGHT_DIR``: directory for ``blackbox.rank<N>.json`` crash
+  dumps (default = ``MXNET_TELEMETRY_AGG_DIR``; with neither set the
+  dumps are skipped).
+- ``MXNET_GOODPUT_SLO``: goodput-ratio SLO in [0, 1] — when the
+  per-window (per completed step) productive ratio stays below it for
+  ``MXNET_GOODPUT_SLO_WINDOWS`` consecutive windows, a lifecycle
+  alert event fires and ``mxnet_goodput_slo_breaches_total``
+  increments (default 0 = off).
+- ``MXNET_GOODPUT_SLO_WINDOWS``: consecutive below-SLO windows before
+  the alert fires (default 3).
 - ``MXNET_TRACE_REQUESTS``: per-request serving span traces (queue wait
   → prefill → per-decode-step → sample → finish; default 1 — see
   :mod:`mxnet_tpu.serving.tracing` and the ``/v1/requests`` route).
@@ -430,6 +453,37 @@ def compile_cache_salt():
     return get_str("MXNET_COMPILE_CACHE_SALT", "") or ""
 
 
+def launcher_rank():
+    """Launcher-provided rank from MXNET_WORKER_ID / DMLC_WORKER_ID —
+    the LAUNCHER env on purpose, never ``jax.process_index()``: rank
+    must be knowable without initializing the jax backend (the PR 2
+    checkpoint-primary-election precedent).  One implementation shared
+    by the telemetry aggregator and the flight recorder, so a dump's
+    rank filename and the snapshot's rank label can never disagree."""
+    for name in ("MXNET_WORKER_ID", "DMLC_WORKER_ID"):
+        v = os.environ.get(name)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
+
+
+def launcher_world():
+    """Launcher-provided world size (MXNET_NUM_WORKERS /
+    DMLC_NUM_WORKER; default 1) — same backend-free contract as
+    :func:`launcher_rank`."""
+    for name in ("MXNET_NUM_WORKERS", "DMLC_NUM_WORKER"):
+        v = os.environ.get(name)
+        if v:
+            try:
+                return max(1, int(v))
+            except ValueError:
+                pass
+    return 1
+
+
 def telemetry_agg_every():
     """Cross-rank telemetry aggregation stride: publish/merge per-rank
     snapshots every N-th step-boundary tick (MXNET_TELEMETRY_AGG_EVERY,
@@ -442,6 +496,47 @@ def telemetry_agg_dir():
     aggregator gathers (MXNET_TELEMETRY_AGG_DIR; required for
     aggregation — unset leaves it off even with a stride set)."""
     return get_str("MXNET_TELEMETRY_AGG_DIR")
+
+
+def telemetry_agg_transport():
+    """Cross-rank snapshot-gather transport: ``file`` (shared-dir
+    gather, the default) or ``kv`` (jax.distributed KV store —
+    MXNET_TELEMETRY_AGG_TRANSPORT; black-box dumps stay file-based
+    regardless, the runtime is presumed dead when they are written)."""
+    v = (get_str("MXNET_TELEMETRY_AGG_TRANSPORT", "file") or
+         "file").strip().lower()
+    return v if v in ("file", "kv") else "file"
+
+
+def flight_recorder_enabled():
+    """Distributed flight recorder gate (MXNET_FLIGHT_RECORDER,
+    default on; mxnet_tpu/flight_recorder.py)."""
+    return get_bool("MXNET_FLIGHT_RECORDER", True)
+
+
+def flight_recorder_cap():
+    """Flight-recorder ring capacity in events
+    (MXNET_FLIGHT_RECORDER_CAP, default 4096)."""
+    return max(8, get_int("MXNET_FLIGHT_RECORDER_CAP", 4096))
+
+
+def flight_dir():
+    """Directory for black-box crash dumps (MXNET_FLIGHT_DIR, default
+    = MXNET_TELEMETRY_AGG_DIR — the same gather the telemetry
+    aggregation uses; None when neither is set → dumps are skipped)."""
+    return get_str("MXNET_FLIGHT_DIR") or telemetry_agg_dir()
+
+
+def goodput_slo():
+    """Goodput-ratio SLO threshold in [0, 1] (MXNET_GOODPUT_SLO,
+    default 0 = alerting off)."""
+    return min(1.0, max(0.0, get_float("MXNET_GOODPUT_SLO", 0.0)))
+
+
+def goodput_slo_windows():
+    """Consecutive below-SLO windows (completed steps) before the
+    goodput alert fires (MXNET_GOODPUT_SLO_WINDOWS, default 3)."""
+    return max(1, get_int("MXNET_GOODPUT_SLO_WINDOWS", 3))
 
 
 def trace_requests():
@@ -508,6 +603,23 @@ def describe():
         ("MXNET_TELEMETRY_AGG_DIR", "shared directory for per-rank "
          "snapshot files the aggregator merges (unset = aggregation "
          "off)"),
+        ("MXNET_TELEMETRY_AGG_TRANSPORT", "cross-rank snapshot gather "
+         "transport: file (shared dir, default) or kv (jax.distributed "
+         "KV store; black-box dumps stay file-based)"),
+        ("MXNET_FLIGHT_RECORDER", "distributed flight recorder: "
+         "per-rank collective ledger ring (default 1; "
+         "mxnet_tpu/flight_recorder.py)"),
+        ("MXNET_FLIGHT_RECORDER_CAP", "flight-recorder ring capacity "
+         "in events (default 4096)"),
+        ("MXNET_FLIGHT_DIR", "directory for blackbox.rank<N>.json "
+         "crash dumps (default = MXNET_TELEMETRY_AGG_DIR; neither set "
+         "= dumps skipped)"),
+        ("MXNET_GOODPUT_SLO", "goodput-ratio SLO threshold (default 0 "
+         "= alerting off; below it for N windows fires the breach "
+         "alert)"),
+        ("MXNET_GOODPUT_SLO_WINDOWS", "consecutive below-SLO windows "
+         "(completed steps) before the goodput alert fires "
+         "(default 3)"),
         ("MXNET_TRACE_REQUESTS", "per-request serving span traces "
          "(default 1; 0 = no capture; serving/tracing.py)"),
         ("MXNET_TRACE_KEEP_SLOWEST", "slowest-N request traces always "

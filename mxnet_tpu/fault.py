@@ -189,7 +189,20 @@ def check(seam):
             break
         else:
             return
+    _flight_trip(seam, plan["message"])
     raise plan["error"](plan["message"])
+
+
+def _flight_trip(seam, message):
+    """Seam trip → flight-recorder context event (lazy + tolerant: the
+    recorder is observability, a broken import must not change what the
+    seam raises)."""
+    try:
+        from . import flight_recorder as _flight
+
+        _flight.record_event("fault", seam=seam, message=str(message))
+    except Exception:
+        pass
 
 
 @contextlib.contextmanager

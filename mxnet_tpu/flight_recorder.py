@@ -1,0 +1,312 @@
+"""Distributed flight recorder: a per-rank collective ledger with
+black-box crash dumps.
+
+The introspection plane (PR 14) answers "how is the job doing"; this
+module answers the question the watchdog cannot: **which collective is
+the mesh wedged in, and which rank fell out of program order**.  XLA
+collectives rendezvous by issue order (see parallel/collectives.py's
+equal-call-count contract), so when rank N stalls, the only artifact
+that localizes the hang is a per-rank ledger of what was issued — and
+it has to already exist when the job dies.
+
+Design (all host-side, zero device work, zero host syncs):
+
+- **Always-on preallocated ring** (``MXNET_FLIGHT_RECORDER``, default
+  on; ``MXNET_FLIGHT_RECORDER_CAP`` slots, default 4096).  Recording is
+  one short lock section + one dict build; the per-op eager dispatch
+  path and the serving decode loop never touch it.
+- **Collective ledger**: every Python-level collective issue site
+  (:func:`collective` context manager) stamps an entry carrying a
+  **monotonic per-rank sequence number** and a digest-stable *tag* of
+  ``(op, shape, dtype, axis, bucket-generation)``.  Entry and exit are
+  separate ``perf_counter`` stamps, so a rank wedged *inside* a
+  blocking collective is distinguishable from one that stopped
+  *between* collectives.  Because every SPMD peer issues the same
+  collectives in the same order, equal sequence numbers across ranks
+  must carry equal tags — the alignment key
+  :func:`~mxnet_tpu.telemetry_agg.merge_blackboxes` blames by.
+  ``mxnet_collective_ledger_position`` exports the live position, so
+  cross-rank ledger skew is visible in the telemetry aggregation
+  *before* a hang.
+- **Context events** ride the same ring: step boundaries
+  (telemetry.step_begin/step_end), fault-seam trips, compile events,
+  and lifecycle transitions (stop requests, restarts, SLO breaches) —
+  the "what was the job doing" context around the last collective.
+- **Black-box dumps**: on any abnormal exit (watchdog stall,
+  ``run_with_recovery`` failure, forced grace-deadline exit, unhandled
+  exception in the TrainStep/serving loops) each rank atomically writes
+  its ring as ``blackbox.rank<N>.json`` into the existing
+  ``MXNET_TELEMETRY_AGG_DIR`` file gather (``MXNET_FLIGHT_DIR``
+  overrides).  **Never a collective** — the mesh is presumed broken;
+  each rank dumps alone and the merge happens offline
+  (``tools/teldump blame``) or in the supervisor.
+
+Exit-stamp semantics under async dispatch: jax dispatch is
+asynchronous, so for jitted collective pairs the exit stamp marks
+*dispatch* completion, not device completion — a rank wedged awaiting a
+peer then parks *between* sequence numbers and the merge blames it as
+"never entered seq N+1".  Host-blocking collectives (the host-value
+allreduces, ``barrier``, ``fetch_global``) block inside the context, so
+those wedge as "entered seq N but never exited".  Both shapes are
+first-class blame verdicts.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+import time
+
+from . import env as _env
+from . import telemetry as _telemetry
+
+__all__ = ["enabled", "configure", "collective", "record_event",
+           "position", "snapshot_doc", "dump_blackbox", "reset",
+           "BLACKBOX_PREFIX"]
+
+BLACKBOX_PREFIX = "blackbox.rank"
+
+_LOCK = threading.Lock()
+_STATE = {
+    "enabled": None,      # None = resolve from env on first use
+    "cap": None,
+    "rank": None,
+    "world": None,
+}
+_RING: list = []          # preallocated slots, written at _POS % cap
+_POS = [0]                # total events ever recorded
+_SEQ = [0]                # collective sequence counter (monotonic)
+
+_POSITION = _telemetry.gauge(
+    "mxnet_collective_ledger_position",
+    "monotonic per-rank collective sequence number (flight recorder); "
+    "cross-rank skew of this gauge is a pre-hang signal")
+_DUMPS = _telemetry.counter(
+    "mxnet_flight_dumps_total",
+    "black-box ring dumps written, by abnormal-exit reason",
+    labelnames=("reason",))
+
+
+def _ensure():
+    """Resolve config lazily (lock held by callers or benign to race:
+    worst case two threads build the same ring)."""
+    if _STATE["enabled"] is None:
+        with _LOCK:
+            if _STATE["enabled"] is None:
+                _STATE["cap"] = _env.flight_recorder_cap()
+                _STATE["rank"] = _env.launcher_rank()
+                _STATE["world"] = _env.launcher_world()
+                del _RING[:]
+                _RING.extend([None] * _STATE["cap"])
+                # set "enabled" LAST: it is the lock-free fast-path gate
+                _STATE["enabled"] = _env.flight_recorder_enabled()
+    return _STATE["enabled"]
+
+
+def enabled():
+    """Whether the recorder is on (``MXNET_FLIGHT_RECORDER``, default
+    1; resolved once — :func:`reset` re-reads the env)."""
+    return _ensure()
+
+
+def configure(enabled=None, capacity=None, rank=None, world=None):
+    """Explicit (re)configuration — tests and embedders; production
+    config comes from the env knobs.  Clears the ring."""
+    with _LOCK:
+        _STATE["enabled"] = _env.flight_recorder_enabled() \
+            if enabled is None else bool(enabled)
+        _STATE["cap"] = max(8, int(capacity)) if capacity is not None \
+            else _env.flight_recorder_cap()
+        _STATE["rank"] = _env.launcher_rank() if rank is None else int(rank)
+        _STATE["world"] = _env.launcher_world() if world is None \
+            else int(world)
+        del _RING[:]
+        _RING.extend([None] * _STATE["cap"])
+        _POS[0] = 0
+        _SEQ[0] = 0
+    return dict(_STATE)
+
+
+def reset():
+    """Drop all state; next use re-resolves from the environment
+    (test isolation, bench A/B arms)."""
+    with _LOCK:
+        _STATE.update(enabled=None, cap=None, rank=None, world=None)
+        del _RING[:]
+        _POS[0] = 0
+        _SEQ[0] = 0
+
+
+def _append_locked(entry):
+    _RING[_POS[0] % _STATE["cap"]] = entry
+    _POS[0] += 1
+
+
+def record_event(kind, **fields):
+    """Append one context event (``step`` / ``fault`` / ``compile`` /
+    ``lifecycle`` / caller-defined) to the ring.  Disabled = one dict
+    read."""
+    if not _ensure():
+        return
+    entry = dict(fields)
+    entry["kind"] = str(kind)
+    entry["t"] = time.perf_counter()
+    with _LOCK:
+        _append_locked(entry)
+
+
+def tag_of(op, shape=None, dtype=None, axis=None, generation=None):
+    """The digest-stable collective tag: a readable string plus a short
+    sha256 digest of the same fields — identical on every rank that
+    issues the same collective (the merge's alignment invariant)."""
+    parts = [str(op)]
+    if shape is not None:
+        parts.append("x".join(str(int(d)) for d in tuple(shape)))
+    if dtype is not None:
+        parts.append(str(dtype))
+    if axis is not None:
+        parts.append(str(axis))
+    if generation is not None:
+        parts.append(f"g{generation}")
+    tag = ":".join(parts)
+    digest = hashlib.sha256(tag.encode()).hexdigest()[:12]
+    return tag, digest
+
+
+class _Collective:
+    """One stamped collective: enter allocates the sequence number and
+    the ring entry; exit stamps completion (or the error)."""
+
+    __slots__ = ("_entry",)
+
+    def __init__(self, op, shape, dtype, axis, generation):
+        tag, digest = tag_of(op, shape, dtype, axis, generation)
+        entry = {"kind": "collective", "op": str(op), "tag": tag,
+                 "digest": digest, "t0": time.perf_counter()}
+        if generation is not None:
+            entry["gen"] = str(generation)
+        with _LOCK:
+            _SEQ[0] += 1
+            entry["seq"] = _SEQ[0]
+            _append_locked(entry)
+        self._entry = entry
+        _POSITION.set(entry["seq"])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # exit mutates the entry in place (if the ring wrapped past it
+        # the dict is simply no longer referenced) — under _LOCK: the
+        # watchdog thread's snapshot_doc may be copying this very dict
+        # while the main thread exits a collective, and inserting a key
+        # mid-iteration would raise, silently costing the black box
+        t1 = time.perf_counter()
+        err = repr(exc)[:200] if exc is not None else None
+        with _LOCK:
+            self._entry["t1"] = t1
+            if err is not None:
+                self._entry["error"] = err
+        return False
+
+
+class _NullCollective:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCollective()
+
+
+def collective(op, shape=None, dtype=None, axis=None, generation=None):
+    """Context manager stamping one collective issue: enter records the
+    next per-rank sequence number + the tag digest, exit records
+    completion.  Wrap the *Python issue point* — the call that
+    dispatches the collective (see the module docstring for the async
+    exit-stamp semantics).  Disabled = a shared no-op scope."""
+    if not _ensure():
+        return _NULL
+    return _Collective(op, shape, dtype, axis, generation)
+
+
+def position():
+    """The current collective sequence number (0 before any stamp)."""
+    return _SEQ[0]
+
+
+def snapshot_doc():
+    """The ring as a JSON-able document (in record order, oldest
+    first): rank/world identity, ledger position, and every retained
+    event.  Pure read — safe from any thread, including the watchdog's
+    while the main thread is wedged."""
+    _ensure()
+    with _LOCK:
+        pos, cap = _POS[0], _STATE["cap"]
+        if pos <= cap:
+            events = [dict(e) for e in _RING[:pos]]
+        else:
+            cut = pos % cap
+            events = [dict(e) for e in _RING[cut:] + _RING[:cut]]
+        return {
+            "format": 1,
+            "rank": _STATE["rank"],
+            "world": _STATE["world"],
+            "enabled": bool(_STATE["enabled"]),
+            "capacity": cap,
+            "events_recorded": pos,
+            "position": _SEQ[0],
+            "events": events,
+        }
+
+
+def _dump_dir(directory):
+    if directory:
+        return directory
+    return _env.flight_dir()
+
+
+def dump_blackbox(reason, directory=None):
+    """Atomically write this rank's ring as ``blackbox.rank<N>.json``
+    (tmp + rename — a reader never sees a torn file; the newest
+    abnormal event wins).  Called on abnormal exits only; **never a
+    collective** — each rank dumps alone, the merge happens offline.
+
+    Returns the path, or None when the recorder is disabled or no dump
+    directory is configured (``directory`` argument >
+    ``MXNET_FLIGHT_DIR`` > ``MXNET_TELEMETRY_AGG_DIR``).  Never
+    raises: the dump is the last act of a dying process and must not
+    mask the original failure."""
+    if not _ensure():
+        return None
+    directory = _dump_dir(directory)
+    if not directory:
+        return None
+    try:
+        doc = snapshot_doc()
+        doc["reason"] = str(reason)
+        doc["time"] = time.time()
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=directory, prefix=".tmp_blackbox_")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, default=str)
+            path = os.path.join(
+                directory, f"{BLACKBOX_PREFIX}{doc['rank']}.json")
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except Exception:
+        return None
+    _DUMPS.labels(reason=str(reason)).inc()
+    return path

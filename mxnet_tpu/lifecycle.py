@@ -62,13 +62,14 @@ import traceback
 
 from . import env as _env
 from . import fault
+from . import flight_recorder as _flight
 from . import telemetry
 
 __all__ = ["GracefulExit", "EXIT_PREEMPTED", "EXIT_FORCED", "EXIT_STALLED",
            "request_stop", "stop_requested", "stop_reason", "check_stop",
            "coordinate_stops", "install_signal_handlers",
            "uninstall_signal_handlers", "cancel_grace_deadline",
-           "publish_final_checkpoint",
+           "publish_final_checkpoint", "note_goodput_slo_breach",
            "capture_train_state", "restore_train_state",
            "elastic_resharder",
            "Watchdog", "start_watchdog", "stop_watchdog", "reset"]
@@ -126,6 +127,8 @@ class GracefulExit(Exception):
         # post-stop uploads) is not os._exit'd later for a stop that WAS
         # honored.  A final save that wedges never reaches this line, so
         # the deadline still bounds it.
+        _flight.record_event("lifecycle", event="graceful_exit",
+                             reason=str(reason), step=step)
         cancel_grace_deadline()
 
 
@@ -143,6 +146,8 @@ def request_stop(reason="programmatic"):
         _STOP["time"] = time.time()
     _STOPS_TOTAL.inc()
     _STOP_GAUGE.set(1)
+    _flight.record_event("lifecycle", event="stop_requested",
+                         reason=str(reason))
     # every stop (signal or programmatic) gets the same wall-time bound:
     # no-op when MXNET_GRACE_PERIOD_S is unset
     _arm_grace_deadline()
@@ -257,6 +262,11 @@ def _grace_expired(grace_s):
         "grace period of %.1fs expired before the training loop honored "
         "the stop; force-exiting (status %d) so the scheduler's SIGKILL "
         "does not land mid-checkpoint", grace_s, EXIT_FORCED)
+    # the forced exit is an abnormal end: the ring is the only record
+    # of WHERE the loop was wedged when the deadline landed
+    _flight.record_event("lifecycle", event="grace_deadline_expired",
+                         grace_s=grace_s)
+    _flight.dump_blackbox("grace_deadline_forced_exit")
     logging.shutdown()
     os._exit(EXIT_FORCED)
 
@@ -338,6 +348,23 @@ def reset():
         _HANDLERS["deliveries"] = 0
         _SYNC.update(enabled=False, calls=0, agreed=False)
     _STOP_GAUGE.set(0)
+
+
+def note_goodput_slo_breach(ratio, slo, windows):
+    """The goodput-SLO alert hook (called by ``telemetry`` when the
+    productive ratio stayed below ``MXNET_GOODPUT_SLO`` for
+    ``MXNET_GOODPUT_SLO_WINDOWS`` consecutive windows): a lifecycle
+    event — logged loudly + recorded in the flight-recorder ring so a
+    later crash dump shows the degradation preceded it.  Deliberately
+    NOT a stop: an SLO breach is an operator page, not a reason to
+    strand the mesh."""
+    _LOGGER.warning(
+        "goodput SLO breach: productive ratio %.3f below SLO %.3f for "
+        "%d consecutive windows (mxnet_goodput_slo_breaches_total "
+        "incremented)", ratio, slo, windows)
+    _flight.record_event("lifecycle", event="goodput_slo_breach",
+                         ratio=float(ratio), slo=float(slo),
+                         windows=int(windows))
 
 
 # --------------------------------------------------------------------------
@@ -510,6 +537,7 @@ class Watchdog:
             max(0.05, min(self.timeout_s / 4.0, 1.0))
         self.logger = logger or _LOGGER
         self.last_dump = None
+        self.last_blackbox = None
         self.stall_count = 0
         self._stop_evt = threading.Event()
         self._thread = None
@@ -582,6 +610,16 @@ class Watchdog:
         cause = f"injected fault ({injected})" if injected is not None \
             else (f"no step heartbeat for {age:.1f}s "
                   f"(deadline {self.timeout_s:.1f}s)")
+        _flight.record_event("lifecycle", event="watchdog_stall",
+                             cause=cause, age_s=float(age))
+        # black-box dump FIRST (it is the cross-rank-mergeable artifact
+        # and the abort below never returns); falls back to this
+        # watchdog's own dump dir when no gather dir is configured, so
+        # the ring always lands beside the diagnosis file.  Never a
+        # collective — the mesh is presumed wedged.
+        self.last_blackbox = _flight.dump_blackbox(
+            "watchdog_stall",
+            directory=_env.flight_dir() or self.dump_dir)
         try:
             path = self._write_dump(age, cause)
             self.last_dump = path
@@ -620,6 +658,11 @@ class Watchdog:
             "heartbeat_age_s": age,
             "stacks": self._thread_stacks(),
             "telemetry": telemetry.snapshot(),
+            # this rank's collective ledger: which collective the
+            # wedged thread last entered (or never entered) — the
+            # single-rank half of the cross-rank blame merge
+            "flight_recorder": _flight.snapshot_doc(),
+            "blackbox": self.last_blackbox,
         }
         tmp = path + ".tmp"
         with open(tmp, "w") as f:

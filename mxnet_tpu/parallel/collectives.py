@@ -32,6 +32,13 @@ analysis").  Concretely:
   ``Estimator.fit``) poll it exactly once per step on every rank;
   kvstore fusion plans are a deterministic function of the push-order
   (key, shape, dtype) signature, identical on every peer (PR 4).
+
+Because issue order IS the rendezvous key, every Python-level issue
+site here also stamps the distributed flight recorder
+(:mod:`mxnet_tpu.flight_recorder`): a monotonic per-rank sequence
+number + a digest of (op, shape, dtype, axis, generation), so a hang
+or desync is blamable post-mortem from the per-rank black-box rings
+(machine-enforced by mxtpu-check pass ``ledger-discipline``, MXT100).
 """
 from __future__ import annotations
 
@@ -83,7 +90,13 @@ def fetch_global(arr):
         return np.asarray(arr)
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    from .. import flight_recorder as _flight
+
+    with _flight.collective("fetch_global",
+                            shape=getattr(arr, "shape", None),
+                            dtype=getattr(arr, "dtype", None)):
+        return np.asarray(multihost_utils.process_allgather(arr,
+                                                            tiled=True))
 
 
 def shard_map(fn, mesh, in_specs, out_specs):
@@ -193,7 +206,8 @@ def _sum_combine(a, nl):
     return a.sum(axis=0) / nl
 
 
-def _combine_with_seam(local_leaves, combine_fn, static_args=()):
+def _combine_with_seam(local_leaves, combine_fn, static_args=(),
+                       op="allreduce"):
     """Route a host-value collective through the ``collectives.allreduce``
     fault seam.  Single-process (tests, _testing_force paths): the full
     retry policy applies, so injected transient faults are absorbed end
@@ -202,18 +216,28 @@ def _combine_with_seam(local_leaves, combine_fn, static_args=()):
     never issue the matching one, so the retry hangs the mesh); a real
     transient interconnect failure instead escalates to
     checkpoint.run_with_recovery, which restarts every process together —
-    bounded backoff at the scope where retry is actually safe."""
+    bounded backoff at the scope where retry is actually safe.
+
+    Flight-recorder stamp: this is the single funnel every host-value
+    collective flows through, so the ledger entry (``op`` + the lead
+    leaf's shape/dtype) is stamped HERE — seam trip included, so a
+    failed issue shows in the ring with its error."""
     import jax
 
     from .. import fault
+    from .. import flight_recorder as _flight
 
-    if jax.process_count() == 1:
-        return fault.call_with_retries(
-            "collectives.allreduce", _cross_process_combine,
-            local_leaves, combine_fn, static_args=static_args)
-    fault.check("collectives.allreduce")
-    return _cross_process_combine(local_leaves, combine_fn,
-                                  static_args=static_args)
+    lead = local_leaves[0] if local_leaves else None
+    with _flight.collective(op, shape=getattr(lead, "shape", None),
+                            dtype=getattr(lead, "dtype", None),
+                            axis="world"):
+        if jax.process_count() == 1:
+            return fault.call_with_retries(
+                "collectives.allreduce", _cross_process_combine,
+                local_leaves, combine_fn, static_args=static_args)
+        fault.check("collectives.allreduce")
+        return _cross_process_combine(local_leaves, combine_fn,
+                                      static_args=static_args)
 
 
 def allreduce_hosts(value, _testing_force=False):
@@ -233,7 +257,7 @@ def allreduce_hosts(value, _testing_force=False):
     if jax.process_count() == 1 and not _testing_force:
         fault.guard("collectives.allreduce")
         return value
-    return _combine_with_seam((value,), _sum_combine)
+    return _combine_with_seam((value,), _sum_combine, op="allreduce")
 
 
 def allreduce_any(flag, _testing_force=False):
@@ -264,7 +288,10 @@ def barrier():
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("mxnet_tpu_barrier")
+        from .. import flight_recorder as _flight
+
+        with _flight.collective("barrier"):
+            multihost_utils.sync_global_devices("mxnet_tpu_barrier")
 
 
 def _int8_quantize(v):
@@ -305,7 +332,8 @@ def allreduce_hosts_quantized(value, _testing_force=False):
         return value
     q, scale = _int8_quantize(value)
     return _combine_with_seam((q, scale), _dequant_sum_combine,
-                              static_args=(value.dtype,))
+                              static_args=(value.dtype,),
+                              op="allreduce_q8")
 
 
 def _dequant_multi_combine(qa, sa, nl, sizes):
@@ -336,7 +364,8 @@ def allreduce_hosts_quantized_multi(values, _testing_force=False):
     flat_q = jnp.concatenate(qs)
     summed = _combine_with_seam((flat_q, jnp.stack(scales)),
                                 _dequant_multi_combine,
-                                static_args=(sizes,))
+                                static_args=(sizes,),
+                                op="allreduce_q8_multi")
     out, off = [], 0
     for v, n in zip(values, sizes):
         out.append(summed[off:off + n].reshape(v.shape).astype(v.dtype))

@@ -687,6 +687,7 @@ class TrainStep:
         loop returns the losses so far — the caller checks
         ``lifecycle.stop_requested()``, publishes its final checkpoint,
         and raises ``lifecycle.GracefulExit``."""
+        from .. import flight_recorder as _flight
         from .. import lifecycle as _lifecycle
         from ..gluon.data.prefetcher import PrefetchIterator
 
@@ -694,24 +695,33 @@ class TrainStep:
                               sharding=self._batch_shard)
         losses = []
         try:
-            while steps is None or len(losses) < steps:
-                if _lifecycle.check_stop():
-                    break
-                try:
-                    batch = next(it)
-                except StopIteration:
-                    break
-                x, y = batch[0], batch[1]
-                losses.append(self(x, y))
-        finally:
-            it.close()
-        if losses:
-            import numpy as _np
+            try:
+                while steps is None or len(losses) < steps:
+                    if _lifecycle.check_stop():
+                        break
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    x, y = batch[0], batch[1]
+                    losses.append(self(x, y))
+            finally:
+                it.close()
+            if losses:
+                import numpy as _np
 
-            # ONE deliberate end-of-run sync so step errors surface
-            # inside run(), not at the caller's first read:
-            # mxtpu: noqa[MXT010]
-            _np.asarray(losses[-1])
+                # ONE deliberate end-of-run sync so step errors surface
+                # inside run(), not at the caller's first read:
+                # mxtpu: noqa[MXT010]
+                _np.asarray(losses[-1])
+        except _lifecycle.GracefulExit:
+            raise          # clean preemption, not a crash — no black box
+        except Exception:
+            # unhandled failure in the training loop: dump this rank's
+            # collective ledger (atomic, per-rank, never a collective)
+            # so the cross-rank blame merge has a ring to align
+            _flight.dump_blackbox("train_step_failure")
+            raise
         return losses
 
     def write_back(self):

@@ -54,6 +54,7 @@ import numpy as _np
 
 from .. import env as _env
 from .. import fault as _fault
+from .. import flight_recorder as _flight
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from . import bucketing as _bucketing
@@ -562,11 +563,18 @@ def apply_transfer(plan, arrays, budget_bytes=None):
             return _apply_single_process(plan, arrays, budget_bytes)
         return _apply_multi_process(plan, arrays)
 
-    if jax.process_count() == 1:
-        out = _fault.call_with_retries("resharding.transfer", _run)
-    else:
-        _fault.check("resharding.transfer")
-        out = _run()
+    # ONE ledger entry frames the whole transfer (the multi-process
+    # path's per-entry fetch_global gathers stamp their own sequence
+    # numbers inside it — entry iteration is deterministic, so the
+    # nesting is identical on every peer); generation = the plan digest
+    # prefix, so a desync across differently-computed plans is blamable
+    with _flight.collective("reshard_transfer",
+                            generation=plan.digest()[:12]):
+        if jax.process_count() == 1:
+            out = _fault.call_with_retries("resharding.transfer", _run)
+        else:
+            _fault.check("resharding.transfer")
+            out = _run()
     _TRANSFERS.inc()
     dt = time.perf_counter() - t0
     _SECONDS.observe(dt)

@@ -58,6 +58,7 @@ import numpy as _np
 
 from .. import env as _env
 from .. import fault as _fault
+from .. import flight_recorder as _flight
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from . import bucketing as _bucketing
@@ -229,6 +230,7 @@ class ZeroBucketEngine:
             # rank its contiguous 1/dp shard of the summed gradient;
             # then the same rescale -> clip -> +wd*w order as
             # ops/optimizer_ops.py _prep, on the shard only.
+            # mxtpu: noqa[MXT100] traced shard_map body — step_bucket stamps the issued pair
             g = coll.reduce_scatter(gstack[0], axis_name="dp")
             g = g * rescale
             if clip is not None:
@@ -252,6 +254,7 @@ class ZeroBucketEngine:
                 m_new = b1 * m + (1 - b1) * g
                 v_new = b2 * v + (1 - b2) * jnp.square(g)
                 wf_new = wf - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+                # mxtpu: noqa[MXT100] traced shard_map body — step_bucket stamps the issued pair
                 w_new = coll.all_gather(wf_new, axis_name="dp", axis=0,
                                         tiled=True)
                 return w_new, (m_new, v_new)
@@ -268,6 +271,7 @@ class ZeroBucketEngine:
                 # schedules keep trajectories bit-identical
                 mom_new = mu * mom - lr * g
                 wf_new = wf + mom_new
+                # mxtpu: noqa[MXT100] traced shard_map body — step_bucket stamps the issued pair
                 w_new = coll.all_gather(wf_new, axis_name="dp", axis=0,
                                         tiled=True)
                 return w_new, (mom_new,)
@@ -279,6 +283,7 @@ class ZeroBucketEngine:
             def body(gstack, wfull, lr, wd, rescale):
                 wf = own_shard(wfull)
                 g = prep(gstack, wf, wd, rescale)
+                # mxtpu: noqa[MXT100] traced shard_map body — step_bucket stamps the issued pair
                 w_new = coll.all_gather(wf - lr * g, axis_name="dp",
                                         axis=0, tiled=True)
                 return w_new, ()
@@ -526,19 +531,28 @@ class ZeroBucketEngine:
         jitted = self._get_step(padded, dtype, clip, vec_lr, vec_wd)
         gstack = self._contributions(grad_flats, padded, dtype)
         wfull = self._pad_weight(weight_flat, padded, dtype)
-        if self._kind == "adam":
-            m, v = entry["leaves"]
-            w_new, (m2, v2) = jitted(gstack, wfull, m, v, lr_arg, wd_arg,
-                                     opt.beta1, opt.beta2, opt.epsilon,
-                                     rescale)
-            entry["leaves"] = (m2, v2)
-        elif self._n_state():
-            (mom,) = entry["leaves"]
-            w_new, (mom2,) = jitted(gstack, wfull, mom, lr_arg, wd_arg,
-                                    getattr(opt, "momentum", 0.0), rescale)
-            entry["leaves"] = (mom2,)
-        else:
-            w_new, _ = jitted(gstack, wfull, lr_arg, wd_arg, rescale)
+        # the Python issue point of the shard_map-internal rs+ag pair:
+        # ONE ledger entry per bucket-step, tag carrying the bucket
+        # generation so a replay desync is blamable at the exact plan
+        # (dispatch is async — see flight_recorder's exit-stamp note)
+        with _flight.collective("zero_rs_ag", shape=(padded,),
+                                dtype=dtype, axis="dp",
+                                generation=f"{generation}/b{bucket.index}"):
+            if self._kind == "adam":
+                m, v = entry["leaves"]
+                w_new, (m2, v2) = jitted(gstack, wfull, m, v, lr_arg,
+                                         wd_arg, opt.beta1, opt.beta2,
+                                         opt.epsilon, rescale)
+                entry["leaves"] = (m2, v2)
+            elif self._n_state():
+                (mom,) = entry["leaves"]
+                w_new, (mom2,) = jitted(gstack, wfull, mom, lr_arg,
+                                        wd_arg,
+                                        getattr(opt, "momentum", 0.0),
+                                        rescale)
+                entry["leaves"] = (mom2,)
+            else:
+                w_new, _ = jitted(gstack, wfull, lr_arg, wd_arg, rescale)
         nbytes = padded * dtype.itemsize
         _RS_BYTES.inc(nbytes)
         _AG_BYTES.inc(nbytes)

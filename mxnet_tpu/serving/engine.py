@@ -692,6 +692,15 @@ class ServingEngine:
                         "serving engine step failed %d times in a row "
                         "(%r); failing the wedged in-flight work so "
                         "callers unblock", consec_fail, e)
+                    # a persistently broken serving step is an abnormal
+                    # event: dump the ring (host-side file IO only) so
+                    # the post-mortem shows what preceded the wedge
+                    from .. import flight_recorder as _flight
+
+                    _flight.record_event(
+                        "lifecycle", event="serving_step_failure",
+                        consecutive=consec_fail, error=repr(e)[:200])
+                    _flight.dump_blackbox("serving_step_failure")
                     self._fail_active(e)
                     consec_fail = 0
                 self._stop_evt.wait(0.05)

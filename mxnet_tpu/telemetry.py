@@ -362,6 +362,65 @@ def _chrome_span(name, t0, t1, cat):
         pass
 
 
+def _flight_note(kind, **fields):
+    """Context event into the flight-recorder ring (step boundaries,
+    compile events) — lazy + failure-tolerant like ``_agg_tick``; a
+    disabled recorder costs one module-dict lookup and a bool read."""
+    try:
+        from . import flight_recorder as _flight
+
+        _flight.record_event(kind, **fields)
+    except Exception:
+        pass
+
+
+# -- goodput SLO alerting (ROADMAP follow-on (d)) ---------------------------
+# a WINDOW is one completed timeline step: at each step_end the DELTA
+# of the goodput ledger since the previous step is classified, and
+# MXNET_GOODPUT_SLO_WINDOWS consecutive windows below MXNET_GOODPUT_SLO
+# fire one alert (lifecycle event + counter + flight-recorder entry).
+# The alert re-arms only after a window back at/above the SLO, so a
+# sustained degradation fires once, not every step.
+_SLO_BREACHES = counter(
+    "mxnet_goodput_slo_breaches_total",
+    "goodput-SLO alerts: productive ratio below MXNET_GOODPUT_SLO for "
+    "MXNET_GOODPUT_SLO_WINDOWS consecutive windows")
+_SLO_STATE = {"last": None, "below": 0, "fired": False}
+
+
+def _goodput_slo_tick():
+    slo = _env.goodput_slo()
+    if slo <= 0:
+        return
+    s = goodput_summary()
+    cur = (s["tracked_s"], s["buckets"].get("productive", 0.0))
+    last = _SLO_STATE["last"]
+    _SLO_STATE["last"] = cur
+    if last is None:
+        return
+    d_total = cur[0] - last[0]
+    d_prod = cur[1] - last[1]
+    if d_total <= 0:
+        return          # nothing classified since the last boundary
+    ratio = d_prod / d_total
+    if ratio >= slo:
+        _SLO_STATE["below"] = 0
+        _SLO_STATE["fired"] = False
+        return
+    _SLO_STATE["below"] += 1
+    if _SLO_STATE["fired"] or \
+            _SLO_STATE["below"] < _env.goodput_slo_windows():
+        return
+    _SLO_STATE["fired"] = True
+    _SLO_BREACHES.inc()
+    try:
+        from . import lifecycle as _lc
+
+        _lc.note_goodput_slo_breach(ratio, slo, _SLO_STATE["below"])
+    except Exception:   # alerting must never break a step boundary
+        pass
+
+
 # step heartbeat: monotonic timestamp of the last step-boundary activity
 # (step_begin/step_end, or an explicit heartbeat() from a custom loop /
 # lifecycle.check_stop).  The lifecycle watchdog reads it to enforce a
@@ -396,6 +455,7 @@ def step_begin(step=None):
                 "wall0": time.time(), "phases": {}, "stack": []}
     # return the local, not _CUR["step"]: a concurrent step_end/abort may
     # have nulled _CUR the instant the lock dropped
+    _flight_note("step", event="begin", step=step)
     return step
 
 
@@ -441,6 +501,10 @@ def step_end():
     heartbeat()
     with _LOCK:
         rec = _finalize_locked(time.perf_counter())
+    if rec is not None:
+        _flight_note("step", event="end", step=rec["step"],
+                     wall_s=rec["wall_s"])
+    _goodput_slo_tick()
     _agg_tick()
     return rec
 
@@ -591,6 +655,8 @@ def compile_event(kind, name, elapsed_s, cause, **extra):
                                     **extra))
     _COMPILES_TOTAL.labels(kind=kind, cause=cause).inc()
     _COMPILE_HIST.labels(kind=kind).observe(elapsed_s)
+    _flight_note("compile", name=str(name), compile_kind=str(kind),
+                 cause=str(cause), elapsed_s=float(elapsed_s))
     _chrome_span(f"compile:{kind}:{name}", now - float(elapsed_s), now,
                  "compile")
 
@@ -798,6 +864,7 @@ def reset():
         _CUR = None
         _STEP_SEQ[0] = 0
         _HEARTBEAT[0] = None
+        _SLO_STATE.update(last=None, below=0, fired=False)
 
 
 # --------------------------------------------------------------------------

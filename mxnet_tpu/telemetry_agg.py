@@ -29,6 +29,19 @@ and serves the result at the ``/agg`` route beside ``/metrics``.
 :func:`merge_snapshots` itself is a pure, deterministic function of its
 inputs (CI asserts two merges of the same snapshots are identical), so
 ``tools/teldump`` can re-merge offline from the same files.
+
+Two extensions (ISSUE 15):
+
+- ``MXNET_TELEMETRY_AGG_TRANSPORT=kv`` rides the jax.distributed KV
+  store instead of a shared filesystem (pods without one) — snapshot
+  gather only; the publish/merge semantics are identical.
+- :func:`merge_blackboxes` merges the flight recorder's per-rank
+  ``blackbox.rank<N>.json`` crash dumps
+  (:mod:`mxnet_tpu.flight_recorder`) and emits a **blame verdict** —
+  which collective the mesh wedged in, at which sequence number, and
+  which rank fell out of program order.  Black-box dumps are ALWAYS
+  file-based regardless of the snapshot transport: they are written
+  while the distributed runtime is presumed dead.
 """
 from __future__ import annotations
 
@@ -43,7 +56,8 @@ from . import env as _env
 from . import telemetry as _telemetry
 
 __all__ = ["merge_snapshots", "skew_from_snapshots", "configure",
-           "tick", "publish", "merge_dir", "read_dir", "merged",
+           "tick", "publish", "publish_kv", "read_kv", "merge_dir",
+           "read_dir", "merged", "read_blackboxes", "merge_blackboxes",
            "reset"]
 
 _SKEW_HIST = _telemetry.histogram(
@@ -71,7 +85,16 @@ _STATE = {
     "merged": None,      # latest merged doc (aggregating rank only)
     "route": False,
     "warned": False,
+    # snapshot-gather transport: "file" (shared dir) or "kv" (the
+    # jax.distributed KV store — pods without a shared filesystem,
+    # ROADMAP follow-on (b)).  Black-box dumps are ALWAYS file-based:
+    # they are written while the distributed runtime is presumed dead.
+    "transport": "file",
+    "kv_client": None,   # injected client (tests) or resolved lazily
+    "kv_warned": False,
 }
+
+_KV_PREFIX = "mxnet_tpu/telemetry_agg/rank"
 
 _RANK_FILE = re.compile(r"^rank(\d+)\.json$")
 
@@ -157,11 +180,244 @@ def skew_from_snapshots(snaps):
 
 
 # --------------------------------------------------------------------------
+# black-box merge + blame (the flight-recorder half of this module)
+# --------------------------------------------------------------------------
+_BLACKBOX_FILE = re.compile(r"^blackbox\.rank(\d+)\.json$")
+
+
+def read_blackboxes(directory):
+    """``{rank: blackbox-doc}`` from every readable
+    ``blackbox.rank<N>.json`` in the directory.  A torn/garbage file is
+    skipped — each rank dumped alone while dying, so the merge is
+    best-effort by construction."""
+    boxes = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return boxes
+    for name in sorted(names):
+        m = _BLACKBOX_FILE.match(name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict) or "events" not in doc:
+            continue
+        boxes[int(m.group(1))] = doc
+    return boxes
+
+
+def _ledger_of(doc):
+    """``{seq: collective-entry}`` from one black-box doc (ring order;
+    a wrapped ring keeps only the tail — the newest window, which is
+    the one that matters for blame)."""
+    out = {}
+    for e in doc.get("events") or ():
+        if isinstance(e, dict) and e.get("kind") == "collective" \
+                and isinstance(e.get("seq"), int):
+            out[e["seq"]] = e
+    return out
+
+
+def _verdict(kind, detail, ranks=(), seq=None, tag=None, digest=None):
+    return {"kind": kind, "detail": detail,
+            "ranks": sorted(int(r) for r in ranks),
+            "seq": seq, "tag": tag, "digest": digest}
+
+
+def merge_blackboxes(boxes):
+    """Merge ``{rank: blackbox-doc}`` into one report with a **blame
+    verdict** — pure and deterministic (no clock reads; same boxes in →
+    byte-identical document out, the property ``teldump blame``'s
+    offline re-merge relies on).
+
+    The ledgers align by the per-rank collective sequence number: the
+    equal-call-count contract (parallel/collectives.py) means equal
+    seq across ranks must carry equal tags.  Verdicts, in priority
+    order:
+
+    - ``desync`` — the first sequence number where ranks' tags diverge
+      (a rank issued a different/extra collective); blamed ranks are
+      the minority tag holders at that seq.
+    - ``hang`` — the lagging rank(s): wedged *inside* their last
+      entered collective (no exit stamp), failed in it (error stamp),
+      or stopped *between* collectives (never entered the leaders'
+      next seq).
+    - ``all_wedged`` — every rank entered the SAME seq and none
+      exited: the collective itself (interconnect, a dead device), not
+      a lagging rank.
+    - ``no_blame`` / ``single_rank`` / ``no_data`` — nothing to blame,
+      one ring only, or no rings.
+    """
+    boxes = {int(r): d for r, d in dict(boxes).items()}
+    ranks = sorted(boxes)
+    ledgers = {r: _ledger_of(boxes[r]) for r in ranks}
+    per_rank = {}
+    for r in ranks:
+        led = ledgers[r]
+        last = led[max(led)] if led else None
+        per_rank[r] = {
+            "reason": boxes[r].get("reason"),
+            "time": boxes[r].get("time"),
+            "position": boxes[r].get("position"),
+            "events": len(boxes[r].get("events") or ()),
+            "last_seq": max(led) if led else 0,
+            "first_seq": min(led) if led else 0,
+            "last_tag": last.get("tag") if last else None,
+            "last_exited": bool(last and "t1" in last
+                                and "error" not in last),
+            "last_error": (last or {}).get("error"),
+        }
+    doc = {
+        "format": 1,
+        "ranks": ranks,
+        "per_rank": per_rank,
+        "time": max((boxes[r].get("time") or 0) for r in ranks)
+        if ranks else 0,
+    }
+    doc["verdict"] = _blame(ranks, ledgers, per_rank, boxes)
+    return doc
+
+
+def _blame(ranks, ledgers, per_rank, boxes):
+    if not ranks:
+        return _verdict("no_data", "no black-box files to merge")
+    # -- desync: first seq where tags diverge across any two ranks -----
+    if len(ranks) > 1:
+        shared = set()
+        for r in ranks:
+            shared |= set(ledgers[r])
+        for seq in sorted(shared):
+            tags = {r: ledgers[r][seq].get("tag")
+                    for r in ranks if seq in ledgers[r]}
+            if len(tags) < 2 or len(set(tags.values())) <= 1:
+                continue
+            counts: dict = {}
+            for t in tags.values():
+                counts[t] = counts.get(t, 0) + 1
+            majority = max(sorted(counts), key=lambda t: counts[t])
+            blamed = sorted(r for r, t in tags.items() if t != majority)
+            if len(set(counts.values())) == 1 and len(counts) > 1:
+                blamed = sorted(tags)       # tie: every holder suspect
+            return _verdict(
+                "desync",
+                f"collective tags diverge at seq {seq}: " +
+                ", ".join(f"rank {r}={tags[r]!r}"
+                          for r in sorted(tags)) +
+                " — a rank issued an extra/different collective "
+                "(equal-call-count contract broken)",
+                ranks=blamed, seq=seq,
+                tag=ledgers[blamed[0]][seq].get("tag") if blamed else None,
+                digest=ledgers[blamed[0]][seq].get("digest")
+                if blamed else None)
+    # -- hang: who lags, and where exactly -----------------------------
+    max_seqs = {r: per_rank[r]["last_seq"] for r in ranks}
+    lead = max(max_seqs.values())
+    laggards = sorted(r for r in ranks if max_seqs[r] < lead)
+    # a configured world larger than the dumps we have: a rank that
+    # died without dumping is the primary suspect
+    world = max((boxes[r].get("world") or 0) for r in ranks)
+    missing = sorted(set(range(world)) - set(ranks)) if world > len(ranks) \
+        else []
+    if missing and not laggards:
+        wedged = [r for r in ranks if not per_rank[r]["last_exited"]
+                  and max_seqs[r] > 0]
+        w = wedged[0] if wedged else None
+        detail = (f"rank(s) {missing} wrote no black box"
+                  + (f"; rank {w} is wedged in "
+                     f"{per_rank[w]['last_tag']!r} seq {max_seqs[w]} "
+                     f"waiting on them" if w is not None else ""))
+        return _verdict(
+            "hang", detail, ranks=missing,
+            seq=max_seqs[w] if w is not None else None,
+            tag=per_rank[w]["last_tag"] if w is not None else None,
+            digest=ledgers[w][max_seqs[w]].get("digest")
+            if w is not None else None)
+    if laggards:
+        low = min(max_seqs[r] for r in laggards)
+        blamed = sorted(r for r in laggards if max_seqs[r] == low)
+        b = blamed[0]
+        led = ledgers[b]
+        last = led.get(low)
+        if last is not None and "error" in last:
+            return _verdict(
+                "hang",
+                f"rank {b} failed inside {last.get('tag')!r} seq {low} "
+                f"({last['error']}) and issued nothing after it",
+                ranks=blamed, seq=low, tag=last.get("tag"),
+                digest=last.get("digest"))
+        if last is not None and "t1" not in last:
+            return _verdict(
+                "hang",
+                f"rank {b} entered {last.get('tag')!r} seq {low} but "
+                f"never exited (wedged inside the collective; leaders "
+                f"reached seq {lead})",
+                ranks=blamed, seq=low, tag=last.get("tag"),
+                digest=last.get("digest"))
+        # stopped BETWEEN collectives: blame the first seq it never
+        # entered, tagged from any leading rank's ledger
+        nxt = low + 1
+        tag = digest = None
+        for r in ranks:
+            if nxt in ledgers[r]:
+                tag = ledgers[r][nxt].get("tag")
+                digest = ledgers[r][nxt].get("digest")
+                break
+        return _verdict(
+            "hang",
+            f"rank {b} never entered {tag!r} seq {nxt} (last completed "
+            f"seq {low}; leaders reached seq {lead})",
+            ranks=blamed, seq=nxt, tag=tag, digest=digest)
+    # -- no laggards: same position everywhere --------------------------
+    unexited = sorted(r for r in ranks
+                      if not per_rank[r]["last_exited"] and lead > 0)
+    if unexited and len(unexited) == len(ranks) and len(ranks) > 1:
+        tag = per_rank[ranks[0]]["last_tag"]
+        return _verdict(
+            "all_wedged",
+            f"every rank entered {tag!r} seq {lead} and none exited — "
+            "the collective itself is wedged (interconnect / dead "
+            "device), not a lagging rank",
+            ranks=ranks, seq=lead, tag=tag,
+            digest=ledgers[ranks[0]][lead].get("digest"))
+    if unexited:
+        b = unexited[0]
+        alone = " (single ring — no peer ledger to compare)" \
+            if len(ranks) == 1 else " while peers completed it"
+        return _verdict(
+            "hang",
+            f"rank(s) {unexited} entered {per_rank[b]['last_tag']!r} "
+            f"seq {lead} but never exited{alone}",
+            ranks=unexited, seq=lead, tag=per_rank[b]["last_tag"],
+            digest=ledgers[b][lead].get("digest"))
+    if len(ranks) == 1:
+        return _verdict(
+            "single_rank",
+            f"one ring only (rank {ranks[0]}, reason "
+            f"{per_rank[ranks[0]]['reason']!r}) — nothing to align "
+            "against", ranks=ranks,
+            seq=lead or None, tag=per_rank[ranks[0]]["last_tag"])
+    return _verdict(
+        "no_blame",
+        f"all {len(ranks)} ranks completed the same ledger position "
+        f"(seq {lead}) — no collective-order fault in the recorded "
+        "window", ranks=[])
+
+
+# --------------------------------------------------------------------------
 # the file-based gather
 # --------------------------------------------------------------------------
-def configure(directory=None, every=None, rank=None, world=None):
+def configure(directory=None, every=None, rank=None, world=None,
+              transport=None, kv_client=None):
     """Configure (or reconfigure) the aggregator explicitly.  Defaults
-    come from the env knobs / launcher vars; ``every=0`` disables."""
+    come from the env knobs / launcher vars; ``every=0`` disables.
+    ``transport="kv"`` gathers snapshots through the jax.distributed
+    KV store instead of the shared directory (``kv_client`` injects a
+    client — tests; production resolves the live coordination-service
+    client lazily)."""
     with _LOCK:
         _STATE["dir"] = directory if directory is not None \
             else _env.telemetry_agg_dir()
@@ -170,9 +426,14 @@ def configure(directory=None, every=None, rank=None, world=None):
         _STATE["rank"] = int(rank if rank is not None else _launcher_rank())
         _STATE["world"] = int(world if world is not None
                               else _launcher_world())
+        _STATE["transport"] = str(transport) if transport is not None \
+            else _env.telemetry_agg_transport()
+        _STATE["kv_client"] = kv_client
+        _STATE["kv_warned"] = False
         _STATE["configured"] = True
         _STATE["ticks"] = 0
         if _STATE["every"] > 0 and not _STATE["dir"] \
+                and _STATE["transport"] == "file" \
                 and not _STATE["warned"]:
             _STATE["warned"] = True
             import warnings
@@ -186,44 +447,48 @@ def configure(directory=None, every=None, rank=None, world=None):
 
 
 def _launcher_rank():
-    # launcher env, NOT jax.process_index(): the tick must never force
-    # backend init (the PR 2 checkpoint-primary-election precedent)
-    for name in ("MXNET_WORKER_ID", "DMLC_WORKER_ID"):
-        v = os.environ.get(name)
-        if v:
-            try:
-                return int(v)
-            except ValueError:
-                pass
-    return 0
+    # one shared implementation (env.launcher_rank) so this module's
+    # rank label and the flight recorder's dump filename always agree
+    return _env.launcher_rank()
 
 
 def _launcher_world():
-    for name in ("MXNET_NUM_WORKERS", "DMLC_NUM_WORKER"):
-        v = os.environ.get(name)
-        if v:
-            try:
-                return max(1, int(v))
-            except ValueError:
-                pass
-    return 1
+    return _env.launcher_world()
 
 
 def tick():
     """One step-boundary tick (called by ``telemetry.step_end`` and
     ``lifecycle.check_stop``).  Disabled = one dict read + int check.
     Every ``every``-th tick: publish this rank's snapshot; on rank 0
-    also merge the directory.  Host-side file IO only."""
+    also merge the peers'.  Host-side IO only (file or KV RPC) —
+    never a device collective."""
     with _LOCK:
         if not _STATE["configured"]:
             _configure_locked_from_env()
-        if _STATE["every"] <= 0 or not _STATE["dir"]:
+        transport = _STATE["transport"]
+        if _STATE["every"] <= 0 or \
+                (transport == "file" and not _STATE["dir"]):
             return None
         _STATE["ticks"] += 1
         if _STATE["ticks"] % _STATE["every"] != 0:
             return None
         rank = _STATE["rank"]
+        world = _STATE["world"]
         directory = _STATE["dir"]
+    if transport == "kv":
+        client = _kv_client()
+        if client is None:
+            # no coordination service: fall back to the directory
+            # gather when one is configured, else aggregation is off
+            if not directory:
+                return None
+        else:
+            publish_kv(client, rank)
+            if rank == 0:
+                doc = merge_snapshots(read_kv(client, world))
+                _note_merge(doc)
+                return doc
+            return None
     publish(directory, rank)
     if rank == 0:
         doc = merge_dir(directory)
@@ -236,13 +501,113 @@ def tick():
     return None
 
 
+def _note_merge(doc):
+    """Shared bookkeeping for a completed rank-0 merge (either
+    transport): cache it, feed the skew histogram, mount /agg."""
+    _MERGES.inc()
+    _AGG_RANKS.set(len(doc["ranks"]))
+    for phase, skew in doc["skew"]["phases"].items():
+        _SKEW_HIST.labels(phase=phase).observe(skew)
+    with _LOCK:
+        _STATE["merged"] = doc
+        if not _STATE["route"]:
+            _STATE["route"] = True
+            _telemetry.register_http_route("/agg", _http_agg)
+
+
+# --------------------------------------------------------------------------
+# the KV-store gather (pods without a shared filesystem)
+# --------------------------------------------------------------------------
+def _kv_client():
+    """The live jax.distributed coordination-service client (or the
+    injected test client).  Resolving it must never initialize the
+    backend: only an ALREADY-initialized distributed runtime has one —
+    a missing client warns once and the transport degrades."""
+    with _LOCK:
+        if _STATE["kv_client"] is not None:
+            return _STATE["kv_client"]
+        warned = _STATE["kv_warned"]
+    client = None
+    try:
+        from jax._src import distributed as _dist
+
+        client = getattr(_dist.global_state, "client", None)
+    except Exception:
+        client = None
+    if client is None and not warned:
+        with _LOCK:
+            _STATE["kv_warned"] = True
+        import warnings
+
+        warnings.warn(
+            "MXNET_TELEMETRY_AGG_TRANSPORT=kv but no jax.distributed "
+            "client is live (distributed.init not called?); falling "
+            "back to the file gather"
+            + ("" if _STATE["dir"] else " — and no "
+               "MXNET_TELEMETRY_AGG_DIR either, so aggregation "
+               "stays OFF"), stacklevel=2)
+    return client
+
+
+def publish_kv(client, rank):
+    """Publish this rank's snapshot under ``…/rank<N>`` in the KV
+    store (overwrite-tolerant: the newest publish wins, like the file
+    rename)."""
+    snap = _telemetry.snapshot()
+    snap["rank"] = int(rank)
+    payload = json.dumps(snap)
+    key = f"{_KV_PREFIX}{int(rank)}"
+    try:
+        try:
+            client.key_value_set(key, payload, allow_overwrite=True)
+        except TypeError:           # older client: no overwrite kwarg
+            try:
+                client.key_value_delete(key)
+            except Exception:
+                pass
+            client.key_value_set(key, payload)
+        return True
+    except Exception:
+        # a failed publish degrades the merge, never the job — the
+        # transport contract shared with the file gather
+        return False
+
+
+def read_kv(client, world):
+    """``{rank: snapshot}`` for every rank with a published value —
+    a missing/torn rank is skipped (best-effort merge, exactly like
+    ``read_dir``)."""
+    snaps = {}
+    for r in range(max(1, int(world))):
+        key = f"{_KV_PREFIX}{r}"
+        val = None
+        try:
+            val = client.key_value_try_get(key)
+        except AttributeError:      # older client: blocking get only
+            try:
+                val = client.blocking_key_value_get(key, 50)
+            except Exception:
+                val = None
+        except Exception:
+            val = None
+        if not val:
+            continue
+        try:
+            snaps[r] = json.loads(val)
+        except ValueError:
+            continue
+    return snaps
+
+
 def _configure_locked_from_env():
     _STATE["dir"] = _env.telemetry_agg_dir()
     _STATE["every"] = _env.telemetry_agg_every()
     _STATE["rank"] = _launcher_rank()
     _STATE["world"] = _launcher_world()
+    _STATE["transport"] = _env.telemetry_agg_transport()
     _STATE["configured"] = True
-    if _STATE["every"] > 0 and not _STATE["dir"] and not _STATE["warned"]:
+    if _STATE["every"] > 0 and not _STATE["dir"] \
+            and _STATE["transport"] == "file" and not _STATE["warned"]:
         # the production (env-only) path must warn about the half-set
         # config exactly like explicit configure() does — silence here
         # would leave the operator discovering a 404 at /agg instead
@@ -345,7 +710,8 @@ def reset():
     """Drop configuration + cached merge (test isolation)."""
     with _LOCK:
         _STATE.update(configured=False, dir=None, every=0, rank=0,
-                      world=1, ticks=0, merged=None, warned=False)
+                      world=1, ticks=0, merged=None, warned=False,
+                      transport="file", kv_client=None, kv_warned=False)
         if _STATE["route"]:
             _STATE["route"] = False
             _telemetry.unregister_http_route("/agg")

@@ -84,13 +84,14 @@ def test_pass_catalog_complete():
                            "env-knob-registry", "fault-seam-integrity",
                            "serving-hot-path", "planner-sharding",
                            "graph-pass-contracts", "resharding-transfer",
-                           "metric-registry"}
+                           "metric-registry", "ledger-discipline"}
     all_codes = {c for cls in passes.values() for c in cls.codes}
     assert all_codes == {"MXT001", "MXT002", "MXT003", "MXT005",
                          "MXT006", "MXT010", "MXT020", "MXT021",
                          "MXT022", "MXT030", "MXT031", "MXT032",
                          "MXT040", "MXT050", "MXT060", "MXT070",
-                         "MXT071", "MXT080", "MXT090", "MXT091"}
+                         "MXT071", "MXT080", "MXT090", "MXT091",
+                         "MXT100"}
 
 
 def test_parse_error_reported_not_fatal(tmp_path):
@@ -678,6 +679,88 @@ def test_mxt080_noqa_waiver(tmp_path):
             plan = compute_transfer_plan(src, tgt, sig)
         """)
     assert codes_at(check(tmp_path), "MXT080") == []
+
+
+# -- MXT100 ledger discipline ------------------------------------------------
+def test_mxt100_unstamped_collective_issue_site(tmp_path):
+    """A collective issue site in parallel/ whose enclosing function
+    stamps no flight-recorder ledger entry is flagged; the stamped
+    twin, jax.lax trace-level receivers, and calls from outside
+    parallel/ stay silent."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/parallel/custom.py", """
+        def bad_gather(x):
+            from jax.experimental import multihost_utils
+            return multihost_utils.process_allgather(x)     # line 3
+
+        def bad_pair(x):
+            from . import collectives as coll
+            y = coll.reduce_scatter(x)                      # line 7
+            return coll.all_gather(y)                       # line 8
+
+        def good_stamped(x):
+            from jax.experimental import multihost_utils
+
+            from .. import flight_recorder as _flight
+            with _flight.collective("gather", shape=x.shape):
+                return multihost_utils.process_allgather(x)
+
+        def good_trace_level(x):
+            import jax
+            return jax.lax.all_gather(x, "dp")
+        """)
+    # the SAME unstamped call outside parallel/ is out of scope (its
+    # collective flows through a parallel/ funnel that stamps)
+    put(tmp_path, "mxnet_tpu/elsewhere.py", """
+        def helper(x):
+            from jax.experimental import multihost_utils
+            return multihost_utils.process_allgather(x)
+        """)
+    hits = codes_at(check(tmp_path), "MXT100")
+    lines = sorted(ln for p, ln in hits
+                   if p == "mxnet_tpu/parallel/custom.py")
+    assert lines == [3, 7, 8], hits
+    assert not [h for h in hits if h[0] == "mxnet_tpu/elsewhere.py"]
+
+
+def test_mxt100_self_stamping_funnel_compliant(tmp_path):
+    """Calls to collectives.py functions that stamp the recorder
+    themselves — directly or by delegating to a stamping helper — are
+    compliant by construction (the registry is extracted from the
+    fixture's own collectives.py at check time)."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/parallel/collectives.py", """
+        def _combine(leaves):
+            from .. import flight_recorder as _flight
+            with _flight.collective("allreduce"):
+                return leaves
+
+        def allreduce_hosts(value):
+            return _combine((value,))
+
+        def allreduce_any(flag):
+            return bool(allreduce_hosts(flag))
+        """)
+    put(tmp_path, "mxnet_tpu/parallel/consumer.py", """
+        def agree(flag):
+            from .collectives import allreduce_any
+            return allreduce_any(flag)
+        """)
+    assert codes_at(check(tmp_path), "MXT100") == []
+
+
+def test_mxt100_noqa_waiver(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/parallel/traced.py", """
+        def make_body():
+            from . import collectives as coll
+
+            def body(x):
+                # mxtpu: noqa[MXT100] traced shard_map body — the jit caller stamps
+                return coll.all_gather(x)
+            return body
+        """)
+    assert codes_at(check(tmp_path), "MXT100") == []
 
 
 # -- MXT020-022 lock/thread hygiene -----------------------------------------

@@ -1,0 +1,607 @@
+"""Distributed flight recorder (mxnet_tpu/flight_recorder.py — ISSUE
+15): the per-rank collective ledger ring, black-box crash dumps, the
+cross-rank blame merge (telemetry_agg.merge_blackboxes), the goodput
+SLO alert hook, and the KV aggregation transport."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (env/apply_env side effects)
+from mxnet_tpu import fault, flight_recorder, lifecycle, telemetry
+from mxnet_tpu import telemetry_agg
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import collectives
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("MXNET_FLIGHT_RECORDER", raising=False)
+    monkeypatch.delenv("MXNET_FLIGHT_DIR", raising=False)
+    monkeypatch.delenv("MXNET_TELEMETRY_AGG_DIR", raising=False)
+    monkeypatch.delenv("MXNET_GOODPUT_SLO", raising=False)
+    telemetry.reset()
+    telemetry_agg.reset()
+    flight_recorder.reset()
+    fault.reload_spec()
+    yield
+    telemetry.reset()
+    telemetry_agg.reset()
+    flight_recorder.reset()
+    fault.reload_spec()
+
+
+# --------------------------------------------------------------------------
+# ring mechanics
+# --------------------------------------------------------------------------
+def test_collective_stamp_enter_exit_and_position():
+    flight_recorder.configure(capacity=32, rank=0)
+    with flight_recorder.collective("allreduce", shape=(4,),
+                                    dtype="float32", axis="world"):
+        assert flight_recorder.position() == 1
+    doc = flight_recorder.snapshot_doc()
+    (e,) = doc["events"]
+    assert e["kind"] == "collective" and e["seq"] == 1
+    assert e["tag"] == "allreduce:4:float32:world"
+    assert "t0" in e and "t1" in e and "error" not in e
+    # the ledger-position gauge tracks the live seq
+    pos = telemetry.gauge("mxnet_collective_ledger_position")
+    assert pos.value == 1
+
+
+def test_tag_digest_stable_across_processes_semantics():
+    t1, d1 = flight_recorder.tag_of("zero_rs_ag", shape=(1024,),
+                                    dtype="float32", axis="dp",
+                                    generation="g7/b0")
+    t2, d2 = flight_recorder.tag_of("zero_rs_ag", shape=(1024,),
+                                    dtype="float32", axis="dp",
+                                    generation="g7/b0")
+    assert (t1, d1) == (t2, d2)
+    _, d3 = flight_recorder.tag_of("zero_rs_ag", shape=(1024,),
+                                   dtype="float32", axis="dp",
+                                   generation="g8/b0")
+    assert d3 != d1
+
+
+def test_ring_wraps_keeping_newest_window():
+    flight_recorder.configure(capacity=8, rank=0)
+    for i in range(20):
+        with flight_recorder.collective("c", generation=i):
+            pass
+    doc = flight_recorder.snapshot_doc()
+    assert doc["position"] == 20
+    assert doc["events_recorded"] == 20
+    seqs = [e["seq"] for e in doc["events"]]
+    assert seqs == list(range(13, 21))     # only the newest 8 retained
+
+
+def test_disabled_recorder_is_a_noop(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_FLIGHT_RECORDER", "0")
+    flight_recorder.reset()
+    with flight_recorder.collective("allreduce"):
+        pass
+    flight_recorder.record_event("step", step=1)
+    assert flight_recorder.position() == 0
+    assert flight_recorder.snapshot_doc()["events"] == []
+    assert flight_recorder.dump_blackbox(
+        "x", directory=str(tmp_path)) is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_error_inside_collective_recorded():
+    flight_recorder.configure(capacity=8, rank=0)
+    with pytest.raises(RuntimeError):
+        with flight_recorder.collective("allreduce"):
+            raise RuntimeError("boom")
+    (e,) = flight_recorder.snapshot_doc()["events"]
+    assert "t1" in e and "boom" in e["error"]
+
+
+# --------------------------------------------------------------------------
+# instrumented real paths
+# --------------------------------------------------------------------------
+def test_allreduce_hosts_real_path_stamps():
+    flight_recorder.configure(capacity=16, rank=0)
+    out = collectives.allreduce_hosts(np.ones(4, np.float32),
+                                      _testing_force=True)
+    np.testing.assert_allclose(np.asarray(out), np.ones(4))
+    (e,) = [e for e in flight_recorder.snapshot_doc()["events"]
+            if e["kind"] == "collective"]
+    assert e["op"] == "allreduce" and e["tag"].startswith("allreduce:4:")
+    assert "t1" in e
+    # single-process fast path (no collective issued) must NOT stamp
+    collectives.allreduce_hosts(np.ones(2, np.float32))
+    assert flight_recorder.position() == 1
+
+
+def test_step_fault_and_lifecycle_events_ride_the_ring():
+    flight_recorder.configure(capacity=64, rank=0)
+    telemetry.step_begin()
+    telemetry.step_end()
+    with fault.inject("kvstore.push", error=OSError, times=1):
+        with pytest.raises(OSError):
+            fault.check("kvstore.push")
+    lifecycle.reset()
+    lifecycle.request_stop("unit test")
+    try:
+        kinds = {e["kind"] for e in
+                 flight_recorder.snapshot_doc()["events"]}
+        assert {"step", "fault", "lifecycle"} <= kinds
+        events = flight_recorder.snapshot_doc()["events"]
+        assert any(e.get("event") == "stop_requested" for e in events)
+        assert any(e.get("seam") == "kvstore.push" for e in events)
+    finally:
+        lifecycle.reset()
+
+
+def test_compile_events_recorded():
+    flight_recorder.configure(capacity=64, rank=0)
+    telemetry.compile_event("op", "tadd", 0.01, "new_op")
+    events = flight_recorder.snapshot_doc()["events"]
+    assert any(e["kind"] == "compile" and e["name"] == "tadd"
+               and e["cause"] == "new_op" for e in events)
+
+
+def test_zero_step_bucket_stamps_generation_tag():
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.parallel import bucketing, zero
+
+    flight_recorder.configure(capacity=32, rank=0)
+    eng = zero.ZeroBucketEngine(opt.create("sgd", learning_rate=0.1))
+    (bucket,) = bucketing.assign_buckets(
+        [("k", (8,), "float32")], cap_bytes=1 << 20).buckets
+    g = np.arange(8, dtype=np.float32)
+    w = np.zeros(8, dtype=np.float32)
+    eng.step_bucket(("gen", 0), bucket, [g], w, opt_keys=[0])
+    ledger = [e for e in flight_recorder.snapshot_doc()["events"]
+              if e["kind"] == "collective"]
+    assert any(e["op"] == "zero_rs_ag" and "gen" in e for e in ledger)
+
+
+def test_transfer_params_stamps_reshard_transfer():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import resharding
+
+    flight_recorder.configure(capacity=32, rank=0)
+    arrays = {"w": jnp.arange(8, dtype=jnp.float32)}
+    out = resharding.transfer_params(arrays)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.arange(8))
+    ledger = [e for e in flight_recorder.snapshot_doc()["events"]
+              if e["kind"] == "collective"]
+    assert any(e["op"] == "reshard_transfer" for e in ledger)
+
+
+# --------------------------------------------------------------------------
+# black-box dumps
+# --------------------------------------------------------------------------
+def test_dump_blackbox_schema_and_atomicity(tmp_path):
+    flight_recorder.configure(capacity=16, rank=3, world=4)
+    with flight_recorder.collective("allreduce", shape=(4,)):
+        pass
+    path = flight_recorder.dump_blackbox("unit", directory=str(tmp_path))
+    assert os.path.basename(path) == "blackbox.rank3.json"
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["rank"] == 3 and doc["world"] == 4
+    assert doc["reason"] == "unit" and doc["position"] == 1
+    assert doc["events"][0]["kind"] == "collective"
+    # no stray tmp files (atomic publish)
+    assert sorted(p.name for p in tmp_path.iterdir()) == \
+        ["blackbox.rank3.json"]
+    # a second dump overwrites (newest abnormal event wins)
+    flight_recorder.dump_blackbox("later", directory=str(tmp_path))
+    with open(path) as f:
+        assert json.load(f)["reason"] == "later"
+
+
+def test_dump_defaults_to_agg_dir_and_noops_unconfigured(tmp_path,
+                                                         monkeypatch):
+    flight_recorder.configure(capacity=8, rank=0)
+    assert flight_recorder.dump_blackbox("x") is None   # nowhere to go
+    monkeypatch.setenv("MXNET_TELEMETRY_AGG_DIR", str(tmp_path))
+    path = flight_recorder.dump_blackbox("x")
+    assert path is not None and str(tmp_path) in path
+
+
+def test_read_blackboxes_skips_torn_files(tmp_path):
+    flight_recorder.configure(capacity=8, rank=0)
+    flight_recorder.dump_blackbox("ok", directory=str(tmp_path))
+    (tmp_path / "blackbox.rank1.json").write_text('{"torn":')
+    (tmp_path / "blackbox.rank2.json").write_text('{"no": "events"}')
+    (tmp_path / "unrelated.json").write_text("{}")
+    boxes = telemetry_agg.read_blackboxes(str(tmp_path))
+    assert sorted(boxes) == [0]
+
+
+def test_run_with_recovery_failure_dumps(tmp_path, monkeypatch):
+    from mxnet_tpu.checkpoint import CheckpointManager, run_with_recovery
+
+    monkeypatch.setenv("MXNET_FLIGHT_DIR", str(tmp_path / "flight"))
+    flight_recorder.configure(capacity=32, rank=0)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    calls = {"n": 0}
+
+    def train_fn(start, manager):
+        calls["n"] += 1
+        collectives.allreduce_hosts(np.ones(2, np.float32),
+                                    _testing_force=True)
+        if calls["n"] == 1:
+            raise RuntimeError("first attempt dies")
+        return "done"
+
+    assert run_with_recovery(train_fn, mgr, max_restarts=2,
+                             backoff_ms=0) == "done"
+    box = tmp_path / "flight" / "blackbox.rank0.json"
+    assert box.exists()
+    doc = json.loads(box.read_text())
+    assert doc["reason"] == "run_with_recovery_failure"
+    assert any(e.get("kind") == "collective" for e in doc["events"])
+    assert any(e.get("event") == "train_failure" for e in doc["events"])
+
+
+def test_train_step_run_failure_dumps(tmp_path, monkeypatch):
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    monkeypatch.setenv("MXNET_FLIGHT_DIR", str(tmp_path))
+    flight_recorder.configure(capacity=32, rank=0)
+    net = nn.Dense(2)
+    net.initialize()
+    net(mx.nd.zeros((1, 4)))
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).sum()
+
+    step = TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1})
+
+    def batches():
+        yield (np.zeros((2, 4), np.float32), np.zeros((2, 2), np.float32))
+        raise RuntimeError("input pipeline dies")
+
+    with pytest.raises((RuntimeError, MXNetError)):
+        step.run(batches(), prefetch=0)
+    assert (tmp_path / "blackbox.rank0.json").exists()
+    doc = json.loads((tmp_path / "blackbox.rank0.json").read_text())
+    assert doc["reason"] == "train_step_failure"
+
+
+def test_watchdog_stall_dumps_blackbox_and_diagnosis(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("MXNET_FLIGHT_DIR", str(tmp_path))
+    flight_recorder.configure(capacity=32, rank=0)
+    with flight_recorder.collective("allreduce", shape=(2,)):
+        pass
+    wd = lifecycle.Watchdog(timeout_s=60, abort=False,
+                            dump_dir=str(tmp_path), poll_s=0.01)
+    wd.start()
+    try:
+        import time
+
+        with fault.inject("watchdog.stall", error=RuntimeError, times=1):
+            for _ in range(200):
+                if wd.stall_count:
+                    break
+                time.sleep(0.01)
+    finally:
+        wd.stop()
+    assert wd.stall_count >= 1
+    assert wd.last_blackbox and os.path.exists(wd.last_blackbox)
+    box = json.loads(open(wd.last_blackbox).read())
+    assert box["reason"] == "watchdog_stall"
+    diag = json.loads(open(wd.last_dump).read())
+    assert diag["flight_recorder"]["position"] == 1
+    assert diag["blackbox"] == wd.last_blackbox
+
+
+# --------------------------------------------------------------------------
+# the blame merge (pure)
+# --------------------------------------------------------------------------
+def _entry(seq, tag, exited=True, error=None):
+    e = {"kind": "collective", "seq": seq, "op": tag.split(":")[0],
+         "tag": tag, "digest": f"d{hash(tag) & 0xffff:x}", "t0": 1.0}
+    if exited:
+        e["t1"] = 1.1
+    if error:
+        e["error"] = error
+    return e
+
+
+def _box(rank, entries, reason="watchdog_stall", world=None):
+    return {"format": 1, "rank": rank,
+            "world": world if world is not None else 0,
+            "position": max([e.get("seq", 0) for e in entries] + [0]),
+            "events": entries, "reason": reason, "time": 100.0 + rank}
+
+
+def test_blame_hang_never_entered():
+    tag = "allreduce:1024:float32:world"
+    boxes = {0: _box(0, [_entry(i, tag) for i in range(1, 6)]),
+             1: _box(1, [_entry(i, tag) for i in range(1, 4)])}
+    v = telemetry_agg.merge_blackboxes(boxes)["verdict"]
+    assert v["kind"] == "hang" and v["ranks"] == [1]
+    assert v["seq"] == 4 and v["tag"] == tag
+    assert "never entered" in v["detail"]
+
+
+def test_blame_hang_wedged_inside():
+    tag = "zero_rs_ag:4096:float32:dp:ggen-7/b0"
+    boxes = {0: _box(0, [_entry(i, tag) for i in range(1, 6)]),
+             1: _box(1, [_entry(i, tag) for i in range(1, 4)]
+                     + [_entry(4, tag, exited=False)])}
+    v = telemetry_agg.merge_blackboxes(boxes)["verdict"]
+    assert v["kind"] == "hang" and v["ranks"] == [1] and v["seq"] == 4
+    assert "never exited" in v["detail"]
+
+
+def test_blame_hang_failed_inside():
+    tag = "allreduce:8:float32:world"
+    boxes = {0: _box(0, [_entry(i, tag) for i in range(1, 7)]),
+             1: _box(1, [_entry(i, tag) for i in range(1, 4)]
+                     + [_entry(4, tag, exited=True,
+                               error="OSError('injected')")])}
+    v = telemetry_agg.merge_blackboxes(boxes)["verdict"]
+    assert v["kind"] == "hang" and v["ranks"] == [1] and v["seq"] == 4
+    assert "failed inside" in v["detail"] and v["tag"] == tag
+
+
+def test_blame_desync_first_diverging_seq():
+    boxes = {0: _box(0, [_entry(1, "a:t"), _entry(2, "b:t"),
+                         _entry(3, "c:t")]),
+             1: _box(1, [_entry(1, "a:t"), _entry(2, "EXTRA:t"),
+                         _entry(3, "b:t")]),
+             2: _box(2, [_entry(1, "a:t"), _entry(2, "b:t"),
+                         _entry(3, "c:t")])}
+    v = telemetry_agg.merge_blackboxes(boxes)["verdict"]
+    assert v["kind"] == "desync" and v["seq"] == 2
+    assert v["ranks"] == [1]            # minority tag holder blamed
+    assert "diverge" in v["detail"]
+
+
+def test_blame_all_wedged_and_no_blame():
+    tag = "barrier"
+    wedged = {r: _box(r, [_entry(1, "a:t"),
+                          _entry(2, tag, exited=False)])
+              for r in (0, 1, 2)}
+    v = telemetry_agg.merge_blackboxes(wedged)["verdict"]
+    assert v["kind"] == "all_wedged" and v["seq"] == 2
+    clean = {r: _box(r, [_entry(1, "a:t"), _entry(2, "b:t")])
+             for r in (0, 1)}
+    v = telemetry_agg.merge_blackboxes(clean)["verdict"]
+    assert v["kind"] == "no_blame" and v["ranks"] == []
+
+
+def test_blame_single_rank_and_empty():
+    assert telemetry_agg.merge_blackboxes({})["verdict"]["kind"] == \
+        "no_data"
+    one = {0: _box(0, [_entry(1, "a:t")])}
+    assert telemetry_agg.merge_blackboxes(one)["verdict"]["kind"] == \
+        "single_rank"
+    wedged = {0: _box(0, [_entry(1, "lock:t", exited=False)])}
+    v = telemetry_agg.merge_blackboxes(wedged)["verdict"]
+    assert v["kind"] == "hang" and "single ring" in v["detail"]
+
+
+def test_blame_missing_rank_with_world_metadata():
+    tag = "lockstep:g9"
+    boxes = {1: _box(1, [_entry(1, "a:t"),
+                         _entry(2, tag, exited=False)], world=2)}
+    v = telemetry_agg.merge_blackboxes(boxes)["verdict"]
+    assert v["kind"] == "hang" and v["ranks"] == [0]
+    assert "wrote no black box" in v["detail"] and v["tag"] == tag
+
+
+def test_blame_survives_ring_wrap():
+    tag = "allreduce:4:float32:world"
+    # leader's ring wrapped: only seqs 90..100 retained; laggard died
+    # at seq 50 with a full (unwrapped) window — no seq overlap at all
+    boxes = {0: _box(0, [_entry(i, tag) for i in range(90, 101)]),
+             1: _box(1, [_entry(i, tag) for i in range(40, 51)])}
+    v = telemetry_agg.merge_blackboxes(boxes)["verdict"]
+    assert v["kind"] == "hang" and v["ranks"] == [1]
+    assert v["seq"] == 51                # first seq it never entered
+
+
+def test_blame_merge_is_pure_and_deterministic():
+    tag = "a:t"
+    boxes = {0: _box(0, [_entry(1, tag), _entry(2, tag)]),
+             1: _box(1, [_entry(1, tag)])}
+    d1 = json.dumps(telemetry_agg.merge_blackboxes(boxes),
+                    sort_keys=True)
+    d2 = json.dumps(telemetry_agg.merge_blackboxes(
+        {1: boxes[1], 0: boxes[0]}), sort_keys=True)
+    assert d1 == d2
+
+
+# --------------------------------------------------------------------------
+# end-to-end: chaos wedge via the fault seam -> dump -> merged blame
+# --------------------------------------------------------------------------
+def test_chaos_wedged_allreduce_blamed_end_to_end(tmp_path):
+    """The ISSUE acceptance shape, in-process: rank 0 completes 6
+    allreduces; rank 1 dies inside its 4th (collectives.allreduce
+    seam, non-transient error).  The merged report must name that
+    exact tag, sequence number, and rank — and the offline teldump
+    re-merge must bit-match."""
+    def run_rank(rank, wedge_at=None):
+        flight_recorder.configure(capacity=64, rank=rank, world=2)
+        try:
+            for i in range(6):
+                if wedge_at is not None and i == wedge_at:
+                    with fault.inject("collectives.allreduce",
+                                      error=RuntimeError, times=1):
+                        collectives.allreduce_hosts(
+                            np.ones(16, np.float32),
+                            _testing_force=True)
+                else:
+                    collectives.allreduce_hosts(
+                        np.ones(16, np.float32), _testing_force=True)
+        except RuntimeError:
+            pass
+        return flight_recorder.dump_blackbox(
+            "chaos", directory=str(tmp_path))
+
+    assert run_rank(0) is not None
+    flight_recorder.reset()
+    assert run_rank(1, wedge_at=3) is not None
+
+    boxes = telemetry_agg.read_blackboxes(str(tmp_path))
+    assert sorted(boxes) == [0, 1]
+    doc = telemetry_agg.merge_blackboxes(boxes)
+    v = doc["verdict"]
+    assert v["kind"] == "hang" and v["ranks"] == [1]
+    assert v["seq"] == 4
+    assert v["tag"] == "allreduce:16:float32:world"
+    assert "failed inside" in v["detail"]
+
+    # offline re-merge through the CLI bit-matches the live merge
+    out = tmp_path / "blame.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.teldump", "blame",
+         str(tmp_path), "--out", str(out)],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    assert "HANG" in r.stdout
+    offline = json.loads(out.read_text())
+    assert json.dumps(offline, sort_keys=True) == \
+        json.dumps(doc, sort_keys=True)
+
+
+def test_teldump_blame_empty_dir(tmp_path):
+    from tools import teldump
+
+    assert teldump.main(["blame", str(tmp_path)]) == 1
+
+
+# --------------------------------------------------------------------------
+# goodput SLO alert hook
+# --------------------------------------------------------------------------
+def test_goodput_slo_breach_fires_once_and_rearms(monkeypatch):
+    monkeypatch.setenv("MXNET_GOODPUT_SLO", "0.9")
+    monkeypatch.setenv("MXNET_GOODPUT_SLO_WINDOWS", "2")
+    flight_recorder.configure(capacity=64, rank=0)
+    breaches = telemetry.counter("mxnet_goodput_slo_breaches_total")
+    telemetry.step_begin()
+    telemetry.step_end()            # baseline window
+    for _ in range(4):              # sustained degradation: ONE alert
+        telemetry.goodput_note("checkpoint", 10.0)
+        telemetry.step_begin()
+        telemetry.step_end()
+    assert breaches.value == 1
+    events = [e for e in flight_recorder.snapshot_doc()["events"]
+              if e.get("event") == "goodput_slo_breach"]
+    assert len(events) == 1 and events[0]["slo"] == 0.9
+    # recovery (pure productive windows) re-arms; second episode fires
+    import time
+
+    for _ in range(2):
+        telemetry.step_begin()
+        time.sleep(0.002)
+        telemetry.step_end()
+    for _ in range(3):
+        telemetry.goodput_note("checkpoint", 10.0)
+        telemetry.step_begin()
+        telemetry.step_end()
+    assert breaches.value == 2
+
+
+def test_goodput_slo_off_by_default():
+    telemetry.step_begin()
+    telemetry.step_end()
+    telemetry.goodput_note("checkpoint", 100.0)
+    telemetry.step_begin()
+    telemetry.step_end()
+    assert telemetry.counter(
+        "mxnet_goodput_slo_breaches_total").value == 0
+
+
+# --------------------------------------------------------------------------
+# KV aggregation transport
+# --------------------------------------------------------------------------
+class _FakeKV:
+    """Coordination-service double: strict key_value_set (no silent
+    overwrite without the kwarg) + try_get, like the jaxlib client."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if key in self.store and not allow_overwrite:
+            raise RuntimeError(f"ALREADY_EXISTS: {key}")
+        self.store[key] = value
+
+    def key_value_try_get(self, key):
+        if key not in self.store:
+            raise KeyError(key)
+        return self.store[key]
+
+
+class _LegacyKV(_FakeKV):
+    """Older client: no allow_overwrite kwarg, no try_get."""
+
+    def key_value_set(self, key, value):
+        if key in self.store:
+            raise RuntimeError(f"ALREADY_EXISTS: {key}")
+        self.store[key] = value
+
+    def key_value_delete(self, key):
+        self.store.pop(key, None)
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key not in self.store:
+            raise TimeoutError(key)
+        return self.store[key]
+
+    key_value_try_get = property()   # makes attr access raise
+
+
+def test_kv_transport_publish_merge_and_repeat():
+    fake = _FakeKV()
+    snap1 = telemetry.snapshot()
+    snap1["rank"] = 1
+    fake.store["mxnet_tpu/telemetry_agg/rank1"] = json.dumps(snap1)
+    telemetry_agg.configure(every=1, rank=0, world=2, transport="kv",
+                            kv_client=fake, directory="")
+    telemetry.step_begin()
+    telemetry.step_end()
+    doc = telemetry_agg.merged()
+    assert doc is not None and doc["ranks"] == [0, 1]
+    assert "mxnet_tpu/telemetry_agg/rank0" in fake.store
+    # second tick republishes (overwrite path) and re-merges
+    telemetry.step_begin()
+    telemetry.step_end()
+    assert telemetry_agg.merged()["ranks"] == [0, 1]
+
+
+def test_kv_transport_legacy_client_delete_then_set():
+    legacy = _LegacyKV()
+    assert telemetry_agg.publish_kv(legacy, 0) is True
+    assert telemetry_agg.publish_kv(legacy, 0) is True   # overwrite
+    snaps = telemetry_agg.read_kv(legacy, 2)
+    assert sorted(snaps) == [0]     # rank 1 missing = skipped
+
+
+def test_kv_transport_nonzero_rank_publishes_only():
+    fake = _FakeKV()
+    telemetry_agg.configure(every=1, rank=1, world=2, transport="kv",
+                            kv_client=fake, directory="")
+    telemetry.step_begin()
+    telemetry.step_end()
+    assert telemetry_agg.merged() is None
+    assert "mxnet_tpu/telemetry_agg/rank1" in fake.store
+
+
+def test_kv_transport_without_client_warns_and_degrades(tmp_path):
+    telemetry_agg.configure(every=1, rank=0, world=2, transport="kv",
+                            directory=str(tmp_path))
+    with pytest.warns(UserWarning, match="no jax.distributed client"):
+        telemetry.step_begin()
+        telemetry.step_end()
+    # fell back to the file gather (the configured directory)
+    assert (tmp_path / "rank0.json").exists()

@@ -16,6 +16,7 @@ from . import envknobs  # noqa: F401
 from . import faultseams  # noqa: F401
 from . import graphpass  # noqa: F401
 from . import hotpath  # noqa: F401
+from . import ledger  # noqa: F401
 from . import metrics  # noqa: F401
 from . import pairing  # noqa: F401
 from . import planner  # noqa: F401

@@ -1,0 +1,111 @@
+"""MXT100: ledger discipline — collective issue sites stamp the flight
+recorder.
+
+ISSUE 15's distributed flight recorder
+(:mod:`mxnet_tpu.flight_recorder`) turns a hang or SPMD desync from
+"rank N stalled somewhere" into "rank N never entered allreduce seq
+4127" — but only if **every** Python-level collective issue site in
+``mxnet_tpu/parallel/`` stamps the ring.  A single unstamped site makes
+the per-rank sequence numbers diverge from the true issue order and the
+cross-rank blame merge points at the wrong collective.  This pass keeps
+the ledger closed as new collective call sites land:
+
+- **Flagged**: a call to a collective-issuing function (the host-value
+  allreduce family, ``barrier``, ``fetch_global``, the raw
+  ``process_allgather`` / ``sync_global_devices``, and the repo's
+  ``reduce_scatter`` / ``all_gather`` wrappers) inside ``parallel/``
+  whose enclosing outermost function contains no
+  ``flight_recorder.collective(...)`` stamp.
+- **Compliant by construction**: calls to *self-stamping funnels* —
+  functions in ``parallel/collectives.py`` that stamp the recorder
+  themselves, directly or by delegation
+  (``RepoModel.collective_stampers``, extracted from the source at
+  check time so the trusted set can never drift).  ``allreduce_any``
+  → ``allreduce_hosts`` → ``_combine_with_seam`` (the stamp) is the
+  canonical chain.
+- **Exempt**: ``jax.lax.*`` receivers — trace-level primitives inside
+  ``shard_map`` bodies issue at jit dispatch, not at their own line;
+  their Python issue point (e.g. ``ZeroBucketEngine.step_bucket``)
+  carries the stamp, and sites that cannot (the traced body builders
+  in ``zero.py``) carry a reasoned ``noqa`` naming where the stamp
+  lives.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name
+from ..core import Finding, Pass, register
+from ..repo import flight_aliases, is_stamp_call
+from .pairing import _outermost_functions
+
+# collective-issuing callables whose Python call site IS a runtime
+# issue point (host-level families + the repo shard_map-pair wrappers)
+_COLLECTIVE_NAMES = {
+    "allreduce_hosts", "allreduce_hosts_quantized",
+    "allreduce_hosts_quantized_multi", "allreduce_any", "barrier",
+    "fetch_global", "process_allgather", "sync_global_devices",
+    "reduce_scatter", "all_gather",
+}
+
+
+def _tail(name):
+    return (name or "").rsplit(".", 1)[-1]
+
+
+def _lax_receiver(name):
+    """jax.lax.* (trace-level primitive) — exempt; see module doc."""
+    return name.startswith("lax.") or ".lax." in name
+
+
+@register
+class LedgerDiscipline(Pass):
+    name = "ledger-discipline"
+    codes = {
+        "MXT100": "collective issue site without a flight-recorder "
+                  "stamp",
+    }
+
+    def run(self, ctx, mod):
+        if "parallel/" not in mod.relpath:
+            return []
+        stampers = ctx.repo.collective_stampers
+        mod_al, fn_al = flight_aliases(mod.tree)
+        findings = []
+        for fn in _outermost_functions(mod.tree):
+            if fn.name in _COLLECTIVE_NAMES:
+                continue  # primitive wrapper definition, not a call site
+            calls = [sub for sub in ast.walk(fn)
+                     if isinstance(sub, ast.Call)]
+            has_stamp = any(is_stamp_call(c, mod_al, fn_al)
+                            for c in calls)
+            for call in calls:
+                name = call_name(call)
+                if name is None:
+                    continue
+                tail = _tail(name)
+                if tail not in _COLLECTIVE_NAMES:
+                    continue
+                if _lax_receiver(name):
+                    continue
+                if tail in stampers:
+                    continue    # self-stamping funnel (collectives.py)
+                if has_stamp:
+                    continue    # this function stamps the ledger itself
+                findings.append(Finding(
+                    code="MXT100", path=mod.relpath, line=call.lineno,
+                    message=f"collective issue site {name!r} in "
+                            f"{fn.name!r} does not stamp the flight "
+                            f"recorder",
+                    hint="wrap the issue point in flight_recorder."
+                         "collective(op, shape=..., dtype=...) (see "
+                         "parallel/collectives.py _combine_with_seam), "
+                         "call a self-stamping funnel, or carry a "
+                         "reasoned `# mxtpu: noqa[MXT100]` naming "
+                         "where the stamp lives — an unstamped issue "
+                         "desyncs the per-rank ledger the hang-blame "
+                         "merge aligns by",
+                    scope=mod.qualname(call),
+                    key=f"unstamped:{tail}",
+                    col=call.col_offset))
+        return findings

@@ -40,6 +40,48 @@ def expand_metric_token(tok):
     return names
 
 
+def flight_aliases(tree):
+    """``(module_aliases, fn_aliases)`` — names the module binds to the
+    flight-recorder module / its ``collective`` stamper (top-level AND
+    function-local imports: the repo's lazy-import idiom)."""
+    mod_aliases, fn_aliases = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for alias in node.names:
+                if alias.name == "flight_recorder":
+                    mod_aliases.add(alias.asname or alias.name)
+                elif mod.endswith("flight_recorder") \
+                        and alias.name == "collective":
+                    fn_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith("flight_recorder"):
+                    mod_aliases.add(alias.asname or alias.name)
+    return mod_aliases, fn_aliases
+
+
+def is_stamp_call(call, mod_aliases, fn_aliases):
+    """Is this Call a flight-recorder collective stamp
+    (``_flight.collective(...)`` / aliased forms)?"""
+    name = None
+    node = call.func
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        name = ".".join(reversed(parts))
+    if not name:
+        return False
+    if "." not in name:
+        return name in fn_aliases
+    recv, tail = name.rsplit(".", 1)
+    return tail == "collective" and (
+        recv in mod_aliases or recv.endswith("flight_recorder"))
+
+
 class RepoModel:
     """Lazily-extracted registries for the repo rooted at ``root``."""
 
@@ -49,6 +91,7 @@ class RepoModel:
         self._seams = None
         self._readme = None
         self._metrics = None
+        self._stampers = None
 
     # -- env knob registry (mxnet_tpu/env.py) ------------------------------
     def _load_env(self):
@@ -124,6 +167,52 @@ class RepoModel:
                                     isinstance(elt.value, str):
                                 self._seams.add(elt.value)
         return self._seams
+
+    # -- flight-recorder self-stamping collective funnels ------------------
+    @property
+    def collective_stampers(self):
+        """Module-level functions in ``mxnet_tpu/parallel/collectives.py``
+        that stamp the flight recorder themselves — directly, or by
+        delegating to another function in the same module that does
+        (transitive fixed point).  A call to one of these is a
+        compliant ledger entry by construction, so the
+        ``ledger-discipline`` pass never asks its caller for a second
+        stamp.  Extracted from the source at check time, so the pass
+        can never drift from the funnels it trusts."""
+        if self._stampers is None:
+            self._stampers = set()
+            path = os.path.join(self.root, "mxnet_tpu", "parallel",
+                                "collectives.py")
+            if os.path.exists(path):
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+                mod_al, fn_al = flight_aliases(tree)
+                funcs = {}
+                for node in tree.body:
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        funcs[node.name] = node
+                direct, calls_of = set(), {}
+                for name, fn in funcs.items():
+                    called = set()
+                    for sub in ast.walk(fn):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        if is_stamp_call(sub, mod_al, fn_al):
+                            direct.add(name)
+                        elif isinstance(sub.func, ast.Name):
+                            called.add(sub.func.id)
+                    calls_of[name] = called
+                stamped = set(direct)
+                changed = True
+                while changed:
+                    changed = False
+                    for name, called in calls_of.items():
+                        if name not in stamped and called & stamped:
+                            stamped.add(name)
+                            changed = True
+                self._stampers = stamped
+        return self._stampers
 
     # -- README metric catalog ---------------------------------------------
     @property

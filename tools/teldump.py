@@ -10,6 +10,7 @@ Usage::
     python -m tools.teldump show snap.json [--metrics PREFIX]
     python -m tools.teldump diff before.json after.json
     python -m tools.teldump agg  /path/to/agg_dir   # offline re-merge
+    python -m tools.teldump blame /path/to/agg_dir  # black-box blame
 
 ``show`` prints the metric families (counters/gauges as values,
 histograms as count/sum/mean), the step-phase breakdown, the goodput
@@ -19,7 +20,12 @@ and step-rate change between two snapshots of the SAME process (the
 :func:`mxnet_tpu.telemetry_agg.merge_snapshots` over a directory of
 rank files and prints the per-rank summary + straggler skew — the
 same merge the live aggregator serves at ``/agg``, reproducible
-offline because the merge is deterministic.
+offline because the merge is deterministic.  ``blame`` merges the
+``blackbox.rank<N>.json`` flight-recorder dumps each rank wrote on its
+abnormal exit (:func:`mxnet_tpu.telemetry_agg.merge_blackboxes` —
+pure, so the offline re-merge bit-matches any live one) and prints the
+verdict: which collective the mesh wedged in, at which per-rank
+sequence number, and which rank fell out of program order.
 """
 from __future__ import annotations
 
@@ -159,6 +165,39 @@ def cmd_agg(args):
     return 0
 
 
+def cmd_blame(args):
+    from mxnet_tpu import telemetry_agg
+
+    boxes = telemetry_agg.read_blackboxes(args.directory)
+    if not boxes:
+        print(f"no blackbox.rank*.json files in {args.directory}",
+              file=sys.stderr)
+        return 1
+    doc = telemetry_agg.merge_blackboxes(boxes)
+    print(f"# black boxes merged: ranks {doc['ranks']}")
+    for rank in doc["ranks"]:
+        pr = doc["per_rank"][rank]
+        state = "exited" if pr["last_exited"] else (
+            "FAILED" if pr["last_error"] else "ENTERED-NOT-EXITED")
+        print(f"  rank {rank}: reason={pr['reason']} "
+              f"seq=[{pr['first_seq']}..{pr['last_seq']}] "
+              f"last={pr['last_tag']} ({state})")
+    v = doc["verdict"]
+    print(f"# verdict: {v['kind'].upper()}")
+    if v.get("seq") is not None:
+        print(f"  seq    {v['seq']}")
+    if v.get("tag"):
+        print(f"  tag    {v['tag']}  (digest {v.get('digest')})")
+    if v.get("ranks"):
+        print(f"  ranks  {v['ranks']}")
+    print(f"  {v['detail']}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+        print(f"# merged blame report written to {args.out}")
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m tools.teldump",
@@ -179,6 +218,13 @@ def main(argv=None):
     p_agg.add_argument("--out", default="",
                        help="also write the merged JSON here")
     p_agg.set_defaults(fn=cmd_agg)
+    p_blame = sub.add_parser(
+        "blame", help="merge black-box rings and print the hang/desync "
+                      "blame verdict")
+    p_blame.add_argument("directory")
+    p_blame.add_argument("--out", default="",
+                         help="also write the merged blame report here")
+    p_blame.set_defaults(fn=cmd_blame)
     args = parser.parse_args(argv)
     try:
         return args.fn(args)
