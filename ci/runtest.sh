@@ -42,6 +42,12 @@
 #               completions bit-matching the full-context forward,
 #               queue-bound 429 rejection, real-child SIGTERM drain ->
 #               EXIT_PREEMPTED) + the serving unit suite
+#   tuning      autotuning smoke (bench.py --tune on the CPU mesh:
+#               search + DB round trip, fused-vs-per-key crossover
+#               direction on the winning bucket cap, zero-trial warm
+#               replay in a second process, cross-process schedule
+#               determinism, tuning-off default trajectory) + the
+#               tuning unit suite
 #   lint        repo-specific static analysis (python -m tools.check:
 #               SPMD collective safety, hot-path host syncs, lock/thread
 #               hygiene, env-knob registry, fault-seam integrity — see
@@ -188,6 +194,20 @@ case "$LANE" in
     #    green/triagable on its own (~35s)
     JAX_PLATFORMS=cpu python -m pytest -q tests/test_serving.py
     ;;
+  tuning)
+    # 1) end-to-end smoke through the PUBLIC surface (ISSUE 16):
+    #    bench.py --tune searches the bucket-cap grid on the ≤32KiB
+    #    fused-allreduce regime, persists the winner, and a second
+    #    process replays it with ZERO trials through the production
+    #    bucket_cap_bytes funnel; schedules are cross-process
+    #    deterministic; with tuning off the DB is never consulted
+    JAX_PLATFORMS=cpu python ci/tuning_smoke.py
+    # 2) the unit suite (knob registry, resolve precedence, DB
+    #    corruption = silent miss, halving determinism).  The unit
+    #    lane also runs this file; the repeat is deliberate — the
+    #    tuning stage must stay green/triagable on its own (~10s)
+    JAX_PLATFORMS=cpu python -m pytest -q tests/test_tuning.py
+    ;;
   nightly)
     # large-tensor + model backwards-compatibility tier (reference:
     # tests/nightly/ + model_backwards_compatibility_check/); set
@@ -198,7 +218,7 @@ case "$LANE" in
     python bench.py | tee BENCH.json
     ;;
   *)
-    echo "unknown lane: $LANE (lint|unit|tpu|dist|chaos|telemetry|overlap|planner|graph|serving|sanity|nightly|bench)" >&2
+    echo "unknown lane: $LANE (lint|unit|tpu|dist|chaos|telemetry|overlap|planner|graph|serving|tuning|sanity|nightly|bench)" >&2
     exit 2
     ;;
 esac

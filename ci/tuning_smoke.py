@@ -1,0 +1,118 @@
+"""Tuning-lane smoke (ISSUE 16): the search-based autotuning tier
+through the PUBLIC surface — ``bench.py --tune`` into a persistent
+TuningDB, then a warm process replaying the winner.
+
+What must hold before this lane goes green:
+
+1. **The search runs and persists** — ``bench.py --tune`` on the
+   ≤32KiB fused-allreduce regime performs real trials, reports a
+   best-vs-default delta, and round-trips the winner through the DB
+   directory (entries on disk, ``stored: true``).
+2. **Crossover direction** — the winning bucket cap is NOT 0: on 16
+   small tensors the fused path (one collective) beats per-key launch
+   overhead (16 collectives), the measured regime bench_overlap pins.
+3. **Zero-trial warm replay** — a second process with ``MXNET_TUNE=1``
+   resolves the stored winner through the production
+   ``bucket_cap_bytes`` funnel with ZERO search trials
+   (``mxnet_tuning_trials_total`` asserted) and one DB hit.
+4. **Cross-process schedule determinism** — two fresh processes
+   compute byte-identical candidate schedules for every knob.
+5. **Default trajectories untouched** — with MXNET_TUNE unset the same
+   process sees the default value and never consults the DB.
+
+Run by ci/runtest.sh tuning as:  JAX_PLATFORMS=cpu python ci/tuning_smoke.py
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run(args, **env):
+    e = dict(os.environ, JAX_PLATFORMS="cpu", **env)
+    r = subprocess.run([sys.executable] + args, cwd=REPO,
+                       capture_output=True, text=True, env=e,
+                       timeout=600)
+    assert r.returncode == 0, (args, r.stdout[-2000:], r.stderr[-2000:])
+    return r.stdout
+
+
+_WARM_SNIPPET = r"""
+import json
+from mxnet_tpu import telemetry, tuning
+from mxnet_tpu.parallel import bucketing
+
+cap_bytes = bucketing.bucket_cap_bytes()
+resolved = tuning.resolve_info("allreduce_bucket_mb")
+snap = telemetry.snapshot()["metrics"]
+def total(name):
+    return sum(int(s["value"])
+               for s in snap.get(name, {}).get("samples", ()))
+print(json.dumps({
+    "cap_bytes": cap_bytes,
+    "resolved": resolved,
+    "trials": total("mxnet_tuning_trials_total"),
+    "hits": total("mxnet_tuning_db_hits_total"),
+}))
+"""
+
+_SCHEDULE_SNIPPET = (
+    "import json; from mxnet_tpu import tuning; "
+    "from mxnet_tpu.tuning import search; "
+    "print(json.dumps({n: search.schedule(tuning.get_knob(n)) "
+    "for n in tuning.knob_names()}, sort_keys=True))")
+
+
+def main():
+    db_dir = tempfile.mkdtemp(prefix="tuning_smoke_db_")
+
+    # 1+2) offline search writes the DB; winner beats per-key (cap 0)
+    out = run(["bench.py", "--tune",
+               "--tune-workloads=allreduce_bucket_mb",
+               "--tune-budget=2"], MXNET_TUNE_DB_DIR=db_dir)
+    doc = json.loads(out.strip().splitlines()[-1])
+    assert doc["metric"] == "tuning_search", doc
+    rep = doc["tune"]["allreduce_bucket_mb"]
+    assert rep["trials"] > 0, rep
+    assert rep["stored"] is True, rep
+    assert rep["winner"] != 0, \
+        f"per-key launch overhead must lose on 16 small tensors: {rep}"
+    assert rep["winner_score"] <= rep["default_score"], rep
+    assert doc["db"]["entries"] >= 1, doc["db"]
+    print(f"tuning-smoke: search ok — winner {rep['winner']}MiB "
+          f"({rep['delta_pct']}% vs default {rep['default']}MiB, "
+          f"{rep['trials']} trials)")
+
+    # 3) warm process: stored winner replayed with ZERO trials
+    warm = json.loads(run(["-c", _WARM_SNIPPET], MXNET_TUNE="1",
+                          MXNET_TUNE_DB_DIR=db_dir).strip())
+    assert warm["trials"] == 0, warm
+    assert warm["hits"] >= 1, warm
+    assert warm["resolved"] == [rep["winner"], "tuned"], warm
+    assert warm["cap_bytes"] == rep["winner"] << 20, warm
+    print("tuning-smoke: warm replay ok — zero trials, "
+          f"cap {warm['cap_bytes']} bytes")
+
+    # 4) two fresh processes compute identical schedules
+    s1 = run(["-c", _SCHEDULE_SNIPPET]).strip()
+    s2 = run(["-c", _SCHEDULE_SNIPPET]).strip()
+    assert s1 == s2, "candidate schedules diverged across processes"
+    print("tuning-smoke: schedule determinism ok")
+
+    # 5) tuning off: the DB must not steer (default trajectory)
+    off = json.loads(run(["-c", _WARM_SNIPPET],
+                         MXNET_TUNE_DB_DIR=db_dir).strip())
+    assert off["resolved"] == [32, "default"], off
+    assert off["hits"] == 0 and off["trials"] == 0, off
+    print("tuning-smoke: tuning-off default trajectory ok")
+    print("tuning-smoke: PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,6 +79,7 @@ from . import fault
 from . import telemetry
 from . import flight_recorder
 from . import lifecycle
+from . import tuning
 
 env.apply_env()
 from . import parallel

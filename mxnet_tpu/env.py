@@ -81,6 +81,23 @@ Wired vars (read at ``import mxnet_tpu``):
 - ``MXNET_FLIGHT_DIR``: directory for ``blackbox.rank<N>.json`` crash
   dumps (default = ``MXNET_TELEMETRY_AGG_DIR``; with neither set the
   dumps are skipped).
+- ``MXNET_TUNE``: the autotuning warm path — resolve knob values from
+  the persistent tuning DB when a ``bench.py --tune`` run stored a
+  winner for this signature/device/jax fingerprint (default 0; the
+  warm path only ever REPLAYS, online exploration stays off — see
+  :mod:`mxnet_tpu.tuning`).  Explicit env pins always beat the DB.
+- ``MXNET_TUNE_DB_DIR``: directory for the persistent tuning DB the
+  warm path reads and ``bench.py --tune`` writes (unset = no DB, the
+  warm path resolves defaults even with ``MXNET_TUNE=1``).
+- ``MXNET_LEDGER_SKEW_THRESHOLD``: cross-rank collective-ledger
+  position divergence (max - min of
+  ``mxnet_collective_ledger_position`` at a merge) that arms the
+  pre-hang alert; sustained for ``MXNET_LEDGER_SKEW_WINDOWS``
+  consecutive aggregation merges it fires one lifecycle alert per
+  episode (default 0 = off; same SLO-hook pattern as the goodput
+  breach — see :mod:`mxnet_tpu.telemetry_agg`).
+- ``MXNET_LEDGER_SKEW_WINDOWS``: consecutive above-threshold merges
+  before the ledger-skew alert fires (default 3).
 - ``MXNET_GOODPUT_SLO``: goodput-ratio SLO in [0, 1] — when the
   per-window (per completed step) productive ratio stays below it for
   ``MXNET_GOODPUT_SLO_WINDOWS`` consecutive windows, a lifecycle
@@ -527,6 +544,33 @@ def flight_dir():
     return get_str("MXNET_FLIGHT_DIR") or telemetry_agg_dir()
 
 
+def tune_enabled():
+    """Autotuning warm-path gate (MXNET_TUNE, default off): resolve
+    knob values from the persistent tuning DB.  Replay only — the warm
+    path never searches (mxnet_tpu/tuning)."""
+    return get_bool("MXNET_TUNE", False)
+
+
+def tune_db_dir():
+    """Directory for the persistent tuning DB (MXNET_TUNE_DB_DIR;
+    unset = no DB — bench.py --tune needs it to persist winners and
+    the warm path needs it to replay them)."""
+    return get_str("MXNET_TUNE_DB_DIR")
+
+
+def ledger_skew_threshold():
+    """Cross-rank collective-ledger position divergence that arms the
+    pre-hang alert (MXNET_LEDGER_SKEW_THRESHOLD, default 0 = off;
+    telemetry_agg's merge hook)."""
+    return max(0, get_int("MXNET_LEDGER_SKEW_THRESHOLD", 0))
+
+
+def ledger_skew_windows():
+    """Consecutive above-threshold aggregation merges before the
+    ledger-skew alert fires (MXNET_LEDGER_SKEW_WINDOWS, default 3)."""
+    return max(1, get_int("MXNET_LEDGER_SKEW_WINDOWS", 3))
+
+
 def goodput_slo():
     """Goodput-ratio SLO threshold in [0, 1] (MXNET_GOODPUT_SLO,
     default 0 = alerting off)."""
@@ -614,6 +658,18 @@ def describe():
         ("MXNET_FLIGHT_DIR", "directory for blackbox.rank<N>.json "
          "crash dumps (default = MXNET_TELEMETRY_AGG_DIR; neither set "
          "= dumps skipped)"),
+        ("MXNET_TUNE", "autotuning warm path: replay stored winners "
+         "from the tuning DB (default 0; env pins always win; "
+         "mxnet_tpu/tuning)"),
+        ("MXNET_TUNE_DB_DIR", "directory for the persistent tuning DB "
+         "(bench.py --tune writes, MXNET_TUNE=1 replays; unset = no "
+         "DB)"),
+        ("MXNET_LEDGER_SKEW_THRESHOLD", "cross-rank ledger-position "
+         "divergence arming the pre-hang alert (default 0 = off; "
+         "sustained N merges fires once per episode)"),
+        ("MXNET_LEDGER_SKEW_WINDOWS", "consecutive above-threshold "
+         "aggregation merges before the ledger-skew alert fires "
+         "(default 3)"),
         ("MXNET_GOODPUT_SLO", "goodput-ratio SLO threshold (default 0 "
          "= alerting off; below it for N windows fires the breach "
          "alert)"),

@@ -134,7 +134,15 @@ class PrefetchIterator:
 
     def __init__(self, source, depth=None, sharding=None, stage_fn=None):
         if depth is None:
-            depth = _env.prefetch_buffer()
+            # the tuning funnel (env pin > MXNET_TUNE=1 winner >
+            # default); the env accessor is the fallback so a broken
+            # tuning tier can never stall the input pipeline
+            try:
+                from ... import tuning as _tuning
+
+                depth = int(_tuning.resolve("prefetch_buffer"))
+            except Exception:
+                depth = _env.prefetch_buffer()
         self._depth = max(0, int(depth))
         self._sharding = sharding
         self._stage = stage_fn or (

@@ -314,7 +314,12 @@ def fuse_elemwise_chains(graph):
     length).  The fused op replays the captured kernels in order under
     the executor's own AMP wrap — one node, one dispatch, identical
     numerics."""
-    cap = _env.graph_fuse_cap()
+    try:
+        from .. import tuning as _tuning
+
+        cap = int(_tuning.resolve("graph_fuse_cap"))
+    except Exception:
+        cap = _env.graph_fuse_cap()
     if cap < 2:
         return graph.copy()
     g = graph.copy()

@@ -70,6 +70,7 @@ __all__ = ["GracefulExit", "EXIT_PREEMPTED", "EXIT_FORCED", "EXIT_STALLED",
            "coordinate_stops", "install_signal_handlers",
            "uninstall_signal_handlers", "cancel_grace_deadline",
            "publish_final_checkpoint", "note_goodput_slo_breach",
+           "note_ledger_skew",
            "capture_train_state", "restore_train_state",
            "elastic_resharder",
            "Watchdog", "start_watchdog", "stop_watchdog", "reset"]
@@ -365,6 +366,27 @@ def note_goodput_slo_breach(ratio, slo, windows):
     _flight.record_event("lifecycle", event="goodput_slo_breach",
                          ratio=float(ratio), slo=float(slo),
                          windows=int(windows))
+
+
+def note_ledger_skew(skew, threshold, windows, laggards):
+    """The ledger-skew pre-hang alert hook (called by
+    ``telemetry_agg`` when the cross-rank collective-ledger position
+    spread stayed above ``MXNET_LEDGER_SKEW_THRESHOLD`` for
+    ``MXNET_LEDGER_SKEW_WINDOWS`` consecutive merges): some rank has
+    stopped issuing collectives while its peers run ahead — the
+    pre-image of the hang the watchdog/black-box machinery will blame
+    *after* the wedge.  Logged loudly + recorded in the flight ring so
+    a later crash dump shows the divergence preceded it.  Deliberately
+    NOT a stop — same contract as the goodput breach."""
+    _LOGGER.warning(
+        "collective-ledger skew alert: cross-rank position spread %d "
+        "above threshold %d for %d consecutive merges; lagging "
+        "rank(s) %s (mxnet_ledger_skew_alerts_total incremented)",
+        skew, threshold, windows, list(laggards))
+    _flight.record_event("lifecycle", event="ledger_skew_alert",
+                         skew=int(skew), threshold=int(threshold),
+                         windows=int(windows),
+                         laggards=[int(r) for r in laggards])
 
 
 # --------------------------------------------------------------------------

@@ -136,13 +136,22 @@ def pl_load(ref, block_idx, block_size):
 
 
 def _fa_block_sizes():
-    """Forward kernel tile sizes, overridable for on-chip tuning sweeps
-    (MXNET_FLASH_BLOCK_Q / MXNET_FLASH_BLOCK_KV; defaults 128 = one MXU
-    lane tile).  Values must divide the padded sequence length."""
-    import os
+    """Forward kernel tile sizes, resolved through the tuning funnel
+    (MXNET_FLASH_BLOCK_Q / MXNET_FLASH_BLOCK_KV pins > MXNET_TUNE=1
+    stored winners > 128 = one MXU lane tile).  Re-read per call on
+    purpose — the op is jit_safe=False exactly so sweeps/trials can
+    vary the tile between calls.  Values must divide the padded
+    sequence length."""
+    try:
+        from .. import tuning as _tuning
 
-    return (int(os.environ.get("MXNET_FLASH_BLOCK_Q", 128)),
-            int(os.environ.get("MXNET_FLASH_BLOCK_KV", 128)))
+        return (int(_tuning.resolve("flash_block_q")),
+                int(_tuning.resolve("flash_block_kv")))
+    except Exception:
+        import os
+
+        return (int(os.environ.get("MXNET_FLASH_BLOCK_Q", 128)),
+                int(os.environ.get("MXNET_FLASH_BLOCK_KV", 128)))
 
 
 def _fa_forward_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None):

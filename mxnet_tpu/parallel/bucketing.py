@@ -52,9 +52,18 @@ _BYPASS_TOTAL = _telemetry.counter(
 
 
 def bucket_cap_bytes():
-    """Fused-bucket size cap in bytes (``MXNET_ALLREDUCE_BUCKET_MB``,
-    default 32 MiB; 0 disables fusion)."""
-    return _env.allreduce_bucket_mb() << 20
+    """Fused-bucket size cap in bytes — resolved through the tuning
+    funnel (``MXNET_ALLREDUCE_BUCKET_MB`` pin > ``MXNET_TUNE=1``
+    stored winner > default 32 MiB; 0 disables fusion).  Import is
+    lazy so the tuning tier stays optional on this hot-ish path; with
+    tuning off the funnel is an env read, exactly what
+    ``_env.allreduce_bucket_mb`` was."""
+    try:
+        from .. import tuning as _tuning
+
+        return max(0, int(_tuning.resolve("allreduce_bucket_mb"))) << 20
+    except Exception:
+        return _env.allreduce_bucket_mb() << 20
 
 
 class Bucket:

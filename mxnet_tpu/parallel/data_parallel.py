@@ -691,6 +691,20 @@ class TrainStep:
         from .. import lifecycle as _lifecycle
         from ..gluon.data.prefetcher import PrefetchIterator
 
+        if prefetch is None:
+            # resolve through the tuning funnel with THIS step's plan
+            # digest, so a per-signature winner (bench.py --tune) can
+            # steer the depth; env pin > winner > default, and the
+            # iterator's own env fallback still guards a broken tier
+            try:
+                from .. import tuning as _tuning
+
+                prefetch = int(_tuning.resolve(
+                    "prefetch_buffer",
+                    plan_digest=self._plan.digest()
+                    if self._plan is not None else None))
+            except Exception:
+                prefetch = None
         it = PrefetchIterator(iter(batches), depth=prefetch,
                               sharding=self._batch_shard)
         losses = []

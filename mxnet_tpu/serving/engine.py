@@ -186,12 +186,30 @@ class ServingEngine:
                     k: jax.device_put(v,
                                       plan.sharding(k, self._serve_mesh))
                     for k, v in self._params.items()}
+        # bucket grids + page size resolve through the tuning funnel
+        # (explicit ctor args > env pins > MXNET_TUNE=1 stored winners
+        # keyed by this engine's plan digest > defaults); the env
+        # accessors remain the fallback so serving never depends on
+        # the tuning tier
+        _pd = plan.digest() if plan is not None else None
+        try:
+            from .. import tuning as _tuning
+
+            _t_batch = str(_tuning.resolve("serving_batch_buckets",
+                                           plan_digest=_pd))
+            _t_prefill = str(_tuning.resolve("serving_prefill_buckets",
+                                             plan_digest=_pd))
+            _t_page = int(_tuning.resolve("serving_page_size",
+                                          plan_digest=_pd))
+        except Exception:
+            _t_batch = _env.serving_batch_buckets()
+            _t_prefill = _env.serving_prefill_buckets()
+            _t_page = _env.serving_page_size()
         self._batch_buckets = list(batch_buckets) if batch_buckets else \
-            parse_buckets(_env.serving_batch_buckets(), "batch bucket")
+            parse_buckets(_t_batch, "batch bucket")
         self._prefill_buckets = list(prefill_buckets) if prefill_buckets \
-            else parse_buckets(_env.serving_prefill_buckets(),
-                               "prefill bucket")
-        self._page_size = int(page_size or _env.serving_page_size())
+            else parse_buckets(_t_prefill, "prefill bucket")
+        self._page_size = int(page_size or _t_page)
         pages = int(kv_pages or _env.serving_kv_pages())
         self._max_batch = int(max_batch or _env.serving_max_batch())
         if self._max_batch > max(self._batch_buckets):

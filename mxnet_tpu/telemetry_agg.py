@@ -73,6 +73,21 @@ _MERGES = _telemetry.counter(
 _AGG_RANKS = _telemetry.gauge(
     "mxnet_telemetry_agg_ranks",
     "ranks present in the latest cross-rank merge")
+_LEDGER_SKEW = _telemetry.gauge(
+    "mxnet_collective_ledger_skew",
+    "max-min spread of the per-rank collective-ledger positions at "
+    "the latest cross-rank merge (a growing spread is the pre-hang "
+    "signature: some rank stopped issuing collectives)")
+_LEDGER_SKEW_ALERTS = _telemetry.counter(
+    "mxnet_ledger_skew_alerts_total",
+    "ledger-skew pre-hang alerts: cross-rank position divergence "
+    "above MXNET_LEDGER_SKEW_THRESHOLD for MXNET_LEDGER_SKEW_WINDOWS "
+    "consecutive aggregation merges")
+
+# episode state for the pre-hang alert — the goodput-SLO discipline
+# (telemetry._goodput_slo_tick): N consecutive above-threshold merges
+# fire ONE alert; a merge back below the threshold re-arms it
+_SKEW_ALERT_STATE = {"above": 0, "fired": False}
 
 _LOCK = threading.Lock()
 _STATE = {
@@ -180,6 +195,64 @@ def skew_from_snapshots(snaps):
 
 
 # --------------------------------------------------------------------------
+# ledger-position skew: the pre-hang alert (flight-recorder follow-on)
+# --------------------------------------------------------------------------
+def _ledger_positions(doc):
+    """``{rank: position}`` from a merged doc's rank-labeled
+    ``mxnet_collective_ledger_position`` samples (a rank without the
+    gauge — recorder off — is simply absent)."""
+    fam = (doc.get("metrics") or {}).get(
+        "mxnet_collective_ledger_position") or {}
+    out = {}
+    for sample in fam.get("samples", ()):
+        r = (sample.get("labels") or {}).get("rank")
+        try:
+            out[int(r)] = float(sample.get("value"))
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def _ledger_skew_tick(doc):
+    """One aggregation-merge window of the pre-hang alert: when the
+    cross-rank ledger-position spread stays above
+    ``MXNET_LEDGER_SKEW_THRESHOLD`` for
+    ``MXNET_LEDGER_SKEW_WINDOWS`` consecutive merges, fire ONE
+    lifecycle alert naming the lagging rank(s); re-arm only after a
+    merge back below the threshold — a sustained divergence pages
+    once, not every merge.  The goodput-SLO hook pattern, moved one
+    layer down: this fires while every rank is still alive, BEFORE
+    the watchdog/black-box machinery has a corpse to blame."""
+    threshold = _env.ledger_skew_threshold()
+    if threshold <= 0:
+        return
+    positions = _ledger_positions(doc)
+    if len(positions) < 2:
+        return          # nothing to diverge from
+    skew = int(max(positions.values()) - min(positions.values()))
+    _LEDGER_SKEW.set(skew)
+    if skew < threshold:
+        _SKEW_ALERT_STATE["above"] = 0
+        _SKEW_ALERT_STATE["fired"] = False
+        return
+    _SKEW_ALERT_STATE["above"] += 1
+    if _SKEW_ALERT_STATE["fired"] or \
+            _SKEW_ALERT_STATE["above"] < _env.ledger_skew_windows():
+        return
+    _SKEW_ALERT_STATE["fired"] = True
+    _LEDGER_SKEW_ALERTS.inc()
+    low = min(positions.values())
+    laggards = sorted(r for r, p in positions.items() if p == low)
+    try:
+        from . import lifecycle as _lc
+
+        _lc.note_ledger_skew(skew, threshold,
+                             _SKEW_ALERT_STATE["above"], laggards)
+    except Exception:   # alerting must never break a merge
+        pass
+
+
+# --------------------------------------------------------------------------
 # black-box merge + blame (the flight-recorder half of this module)
 # --------------------------------------------------------------------------
 _BLACKBOX_FILE = re.compile(r"^blackbox\.rank(\d+)\.json$")
@@ -220,6 +293,21 @@ def _ledger_of(doc):
                 and isinstance(e.get("seq"), int):
             out[e["seq"]] = e
     return out
+
+
+def _last_step_of(doc):
+    """The newest training step this rank's ring mentions (the
+    ``step`` context events telemetry.step_begin/step_end record), or
+    None when the ring holds none — the step-alignment half of blame:
+    seq numbers say WHERE in the collective program a rank stopped,
+    the step events say how far the TRAINING LOOP got."""
+    last = None
+    for e in doc.get("events") or ():
+        if isinstance(e, dict) and e.get("kind") == "step" \
+                and isinstance(e.get("step"), int):
+            if last is None or e["step"] > last:
+                last = e["step"]
+    return last
 
 
 def _verdict(kind, detail, ranks=(), seq=None, tag=None, digest=None):
@@ -270,6 +358,7 @@ def merge_blackboxes(boxes):
             "last_exited": bool(last and "t1" in last
                                 and "error" not in last),
             "last_error": (last or {}).get("error"),
+            "last_step": _last_step_of(boxes[r]),
         }
     doc = {
         "format": 1,
@@ -278,7 +367,27 @@ def merge_blackboxes(boxes):
         "time": max((boxes[r].get("time") or 0) for r in ranks)
         if ranks else 0,
     }
-    doc["verdict"] = _blame(ranks, ledgers, per_rank, boxes)
+    verdict = _blame(ranks, ledgers, per_rank, boxes)
+    # step alignment: when the blamed rank's ring carries step context
+    # events, translate the seq-space verdict into loop-space too —
+    # "rank 3 is 2 steps behind" reads at a glance what seq numbers
+    # only imply.  Pure post-processing of per_rank, so the verdict
+    # stays deterministic.
+    steps = {r: per_rank[r]["last_step"] for r in ranks
+             if per_rank[r]["last_step"] is not None}
+    verdict["step_lag"] = None
+    blamed_with_steps = sorted(r for r in verdict.get("ranks") or ()
+                               if r in steps)
+    if len(steps) > 1 and blamed_with_steps:
+        lead = max(steps.values())
+        b = min(blamed_with_steps, key=lambda r: (steps[r], r))
+        lag = int(lead - steps[b])
+        if lag > 0:
+            verdict["step_lag"] = lag
+            verdict["detail"] += (
+                f"; rank {b} is {lag} step(s) behind "
+                f"(step {steps[b]} vs leaders' step {lead})")
+    doc["verdict"] = verdict
     return doc
 
 
@@ -508,6 +617,7 @@ def _note_merge(doc):
     _AGG_RANKS.set(len(doc["ranks"]))
     for phase, skew in doc["skew"]["phases"].items():
         _SKEW_HIST.labels(phase=phase).observe(skew)
+    _ledger_skew_tick(doc)
     with _LOCK:
         _STATE["merged"] = doc
         if not _STATE["route"]:
@@ -688,6 +798,7 @@ def merge_dir(directory):
     _AGG_RANKS.set(len(doc["ranks"]))
     for phase, skew in doc["skew"]["phases"].items():
         _SKEW_HIST.labels(phase=phase).observe(skew)
+    _ledger_skew_tick(doc)
     return doc
 
 
@@ -712,6 +823,7 @@ def reset():
         _STATE.update(configured=False, dir=None, every=0, rank=0,
                       world=1, ticks=0, merged=None, warned=False,
                       transport="file", kv_client=None, kv_warned=False)
+        _SKEW_ALERT_STATE.update(above=0, fired=False)
         if _STATE["route"]:
             _STATE["route"] = False
             _telemetry.unregister_http_route("/agg")

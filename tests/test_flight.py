@@ -605,3 +605,118 @@ def test_kv_transport_without_client_warns_and_degrades(tmp_path):
         telemetry.step_end()
     # fell back to the file gather (the configured directory)
     assert (tmp_path / "rank0.json").exists()
+
+
+# --------------------------------------------------------------------------
+# ledger-position skew: the pre-hang alert (ISSUE 16 satellite)
+# --------------------------------------------------------------------------
+def _ledger_snap(position, t=100.0):
+    return {"time": t, "steps": [], "metrics": {
+        "mxnet_collective_ledger_position": {
+            "type": "gauge", "help": "",
+            "samples": [{"labels": {}, "value": position}]}}}
+
+
+def _write_positions(tmp_path, positions):
+    for rank, pos in positions.items():
+        with open(tmp_path / f"rank{rank}.json", "w") as f:
+            json.dump(_ledger_snap(pos), f)
+
+
+def test_ledger_skew_alert_fires_once_and_rearms(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_LEDGER_SKEW_THRESHOLD", "10")
+    monkeypatch.setenv("MXNET_LEDGER_SKEW_WINDOWS", "2")
+    flight_recorder.configure(capacity=64, rank=0)
+    alerts = telemetry.counter("mxnet_ledger_skew_alerts_total")
+    _write_positions(tmp_path, {0: 100, 1: 95})     # below threshold
+    telemetry_agg.merge_dir(str(tmp_path))
+    assert alerts.value == 0
+    _write_positions(tmp_path, {0: 100, 1: 80})     # window 1 above
+    telemetry_agg.merge_dir(str(tmp_path))
+    assert alerts.value == 0                        # not yet sustained
+    _write_positions(tmp_path, {0: 120, 1: 90})     # window 2 -> fire
+    telemetry_agg.merge_dir(str(tmp_path))
+    assert alerts.value == 1
+    assert telemetry.gauge("mxnet_collective_ledger_skew").value == 30
+    _write_positions(tmp_path, {0: 150, 1: 100})    # sustained: no refire
+    telemetry_agg.merge_dir(str(tmp_path))
+    assert alerts.value == 1
+    # ONE lifecycle ring event, naming the lagging rank
+    events = [e for e in flight_recorder.snapshot_doc()["events"]
+              if e.get("event") == "ledger_skew_alert"]
+    assert len(events) == 1
+    assert events[0]["laggards"] == [1] and events[0]["threshold"] == 10
+    # a merge back below the threshold re-arms; a second sustained
+    # episode fires again
+    _write_positions(tmp_path, {0: 100, 1: 99})
+    telemetry_agg.merge_dir(str(tmp_path))
+    for _ in range(2):
+        _write_positions(tmp_path, {0: 100, 1: 50})
+        telemetry_agg.merge_dir(str(tmp_path))
+    assert alerts.value == 2
+
+
+def test_ledger_skew_alert_off_by_default(tmp_path):
+    _write_positions(tmp_path, {0: 10_000, 1: 0})
+    telemetry_agg.merge_dir(str(tmp_path))
+    assert telemetry.counter(
+        "mxnet_ledger_skew_alerts_total").value == 0
+
+
+def test_ledger_skew_needs_two_ranks(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_LEDGER_SKEW_THRESHOLD", "1")
+    monkeypatch.setenv("MXNET_LEDGER_SKEW_WINDOWS", "1")
+    _write_positions(tmp_path, {0: 10_000})
+    telemetry_agg.merge_dir(str(tmp_path))
+    assert telemetry.counter(
+        "mxnet_ledger_skew_alerts_total").value == 0
+
+
+# --------------------------------------------------------------------------
+# step-lag in the blame verdict (ISSUE 16 satellite)
+# --------------------------------------------------------------------------
+def _step_event(step):
+    return {"kind": "step", "event": "end", "step": step}
+
+
+def test_blame_verdict_reports_step_lag():
+    """The merged verdict aligns the rings' step context events: the
+    blamed rank's training loop is N steps behind the leaders, and the
+    report says exactly N."""
+    tag = "allreduce:1024:float32:world"
+    boxes = {0: _box(0, [_entry(i, tag) for i in range(1, 6)]
+                     + [_step_event(11), _step_event(12)]),
+             1: _box(1, [_entry(i, tag) for i in range(1, 4)]
+                     + [_step_event(10)])}
+    doc = telemetry_agg.merge_blackboxes(boxes)
+    v = doc["verdict"]
+    assert v["kind"] == "hang" and v["ranks"] == [1]
+    assert v["step_lag"] == 2                       # 12 - 10, pinned
+    assert "rank 1 is 2 step(s) behind" in v["detail"]
+    assert "step 10 vs leaders' step 12" in v["detail"]
+    assert doc["per_rank"][0]["last_step"] == 12
+    assert doc["per_rank"][1]["last_step"] == 10
+
+
+def test_blame_step_lag_none_without_step_events():
+    tag = "allreduce:8:float32:world"
+    boxes = {0: _box(0, [_entry(i, tag) for i in range(1, 6)]),
+             1: _box(1, [_entry(i, tag) for i in range(1, 4)])}
+    doc = telemetry_agg.merge_blackboxes(boxes)
+    assert doc["verdict"]["kind"] == "hang"
+    assert doc["verdict"]["step_lag"] is None
+    assert "behind" not in doc["verdict"]["detail"]
+    assert doc["per_rank"][1]["last_step"] is None
+
+
+def test_blame_step_lag_zero_stays_none():
+    """Same step on both rings: the lag clause must not appear (a
+    zero-lag hang is a collective-program divergence, not a straggler
+    story)."""
+    tag = "allreduce:8:float32:world"
+    boxes = {0: _box(0, [_entry(i, tag) for i in range(1, 6)]
+                     + [_step_event(7)]),
+             1: _box(1, [_entry(i, tag) for i in range(1, 4)]
+                     + [_step_event(7)])}
+    v = telemetry_agg.merge_blackboxes(boxes)["verdict"]
+    assert v["step_lag"] is None and "behind" not in v["detail"]
