@@ -1085,6 +1085,150 @@ def bench_serving():
     }
 
 
+def bench_fleet():
+    """Serving fleet router (ISSUE 17): throughput scaling and
+    kill-recovery cost.
+
+    - **scaling**: closed-loop load through the fleet router at 1 and 3
+      in-process replicas — p50/p99 latency and tokens/s.  Router
+      overhead shows up as the 1-replica delta vs ``extra.serving``;
+      scaling efficiency as the 3-vs-1 tokens/s ratio (sub-linear on a
+      shared CPU, near-linear across real chips).
+    - **kill recovery**: SIGKILL-equivalent on one of 3 replicas under
+      load — time from kill to a ``join_replica`` replacement back in
+      rotation, with the replacement's ready time reported next to the
+      cold first spawn for comparison (process-mode warm-vs-cold is
+      asserted by ci/fleet_smoke.py; in-process on one contended CPU
+      the compile-cache win can wash out)."""
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu import nd, serving
+    from mxnet_tpu.serving import fleet
+    from mxnet_tpu.gluon.model_zoo.language.llama import llama_tiny
+
+    net = llama_tiny()
+    net.initialize()
+    net(nd.zeros((1, 8), dtype="int32"))
+    kw = dict(batch_buckets=[1, 2], prefill_buckets=[8, 16],
+              kv_pages=32, page_size=8, max_batch=2)
+
+    def factory(rid, donor):
+        if donor is not None:
+            return serving.ServingEngine.join_replica(
+                net, donor, **kw).start()
+        return serving.ServingEngine(net, **kw).start()
+
+    max_new = 8
+
+    def percentile(lat, p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+    def mk_fleet(n):
+        mgr = fleet.FleetManager(engine_factory=factory, replicas=n,
+                                 probe_interval_ms=100)
+        router = fleet.Router(retry_budget=1, hedge_ms=5_000,
+                              probe_interval_ms=100, manager=mgr)
+        mgr.attach_router(router)
+        mgr.ensure(n)
+        router.start()
+        return mgr, router
+
+    def run_closed(router, conc=4, total=24):
+        lat, lock = [], threading.Lock()
+        per_client = total // conc
+
+        def client(k):
+            rr = np.random.RandomState(500 + k)
+            for _ in range(per_client):
+                prompt = rr.randint(
+                    1, 512, (int(rr.randint(2, 13)),)).tolist()
+                t1 = time.perf_counter()
+                router.submit(prompt, max_new_tokens=max_new,
+                              deadline_ms=300_000).response(timeout=600)
+                with lock:
+                    lat.append(time.perf_counter() - t1)
+
+        t1 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t1
+        lat.sort()
+        return {
+            "requests": len(lat),
+            "p50_ms": round(percentile(lat, 0.50) * 1e3, 1),
+            "p99_ms": round(percentile(lat, 0.99) * 1e3, 1),
+            "tokens_per_s": round(len(lat) * max_new / wall, 1),
+        }
+
+    out = {}
+    for n in (1, 3):
+        mgr, router = mk_fleet(n)
+        try:
+            out[f"replicas_{n}"] = run_closed(router)
+        finally:
+            router.close()
+            mgr.drain_all(timeout=60)
+
+    # -- kill recovery -----------------------------------------------------
+    mgr, router = mk_fleet(3)
+    try:
+        results, errors = {}, []
+
+        def bg_client(k):
+            rr = np.random.RandomState(900 + k)
+            for _ in range(8):
+                prompt = rr.randint(
+                    1, 512, (int(rr.randint(2, 13)),)).tolist()
+                try:
+                    req = router.submit(prompt, max_new_tokens=4,
+                                        deadline_ms=300_000)
+                    results[req.id] = req.response(timeout=600)
+                except Exception as e:
+                    errors.append(repr(e)[:120])
+
+        threads = [threading.Thread(target=bg_client, args=(k,))
+                   for k in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        victim = router.replicas()[0]
+        t_kill = time.perf_counter()
+        victim.kill()
+        recovered = None
+        while time.perf_counter() - t_kill < 600:
+            if len(router.replicas()) >= 3 and any(
+                    k == "replacement" for _, k, _ in mgr.spawn_times):
+                recovered = time.perf_counter() - t_kill
+                break
+            time.sleep(0.05)
+        for t in threads:
+            t.join()
+        repl_ready = [dt for _, k, dt in mgr.spawn_times
+                      if k == "replacement"]
+        cold_ready = mgr.spawn_times[0][2] if mgr.spawn_times else None
+        out["kill_recovery"] = {
+            "requests_lost": 24 - len(results),
+            "errors": errors[:3],
+            "kill_to_replacement_s": round(recovered, 2)
+            if recovered is not None else None,
+            "replacement_ready_s": round(repl_ready[0], 2)
+            if repl_ready else None,
+            "cold_ready_s": round(cold_ready, 2)
+            if cold_ready is not None else None,
+        }
+    finally:
+        mgr.auto_heal = False
+        router.close()
+        mgr.drain_all(timeout=60)
+    return out
+
+
 def bench_observability():
     """Runtime introspection plane (ISSUE 14): prove the instrumentation
     is free where it must be, and right where it measures.
@@ -1710,6 +1854,13 @@ def main():
         extra["observability"] = bench_observability()
     except Exception as e:
         extra["observability"] = {"error": repr(e)[:200]}
+    try:
+        # serving fleet router (ISSUE 17): closed-loop p50/p99 +
+        # tokens/s at 1 vs 3 replicas (router overhead + scaling), and
+        # kill-to-warm-replacement recovery time under load
+        extra["fleet"] = bench_fleet()
+    except Exception as e:
+        extra["fleet"] = {"error": repr(e)[:200]}
     try:
         # BASELINE binding metric: allreduce bandwidth (tools/bandwidth_
         # measure.py ≙ reference tools/bandwidth/measure.py).  The bus

@@ -123,6 +123,18 @@ case "$LANE" in
     #    the chaos stage must stay green/triagable on its own (ISSUE 2)
     #    and is cheap (~20s).  test_checkpoint.py is NOT repeated.
     JAX_PLATFORMS=cpu python -m pytest -q tests/test_fault.py
+    # 6) the fleet suite incl. the slow real-engine integration tests
+    #    the unit tier's `-m 'not slow'` filter skips (router parity +
+    #    grafted traces, replica.crash chaos, warm join_replica heal,
+    #    HTTP front door)
+    JAX_PLATFORMS=cpu python -m pytest -q tests/test_fleet.py
+    # 7) serving fleet (ISSUE 17): router + 3 REAL engine processes
+    #    over a shared compile cache, SIGKILL one mid-load — zero
+    #    lost/duplicated completions, kill-phase TTFT p99 within 2x the
+    #    healthy baseline, and the auto-heal replacement must join WARM
+    #    (faster than the cold first spawn); plus the in-process
+    #    join_replica donation parity check
+    JAX_PLATFORMS=cpu python ci/fleet_smoke.py
     ;;
   telemetry)
     # 1) end-to-end smoke through the PUBLIC surface (estimator-style

@@ -170,6 +170,22 @@ Wired vars (read at ``import mxnet_tpu``):
 - ``MXNET_SERVING_DEADLINE_MS``: default per-request deadline in ms
   covering queueing + generation (default 0 = none; per-request
   ``deadline_ms`` overrides).
+- ``MXNET_FLEET_REPLICAS``: serving-fleet replica count behind the
+  router (default 2; ``serving.fleet.serve_fleet`` spawns this many
+  real engine processes — see :mod:`mxnet_tpu.serving.fleet`).
+- ``MXNET_FLEET_HEDGE_MS``: floor in ms for the hedged-duplicate delay
+  (default 50; the effective delay is max(this, observed p99 dispatch
+  latency) — a slow replica gets one duplicate on a peer, first winner
+  cancels the loser by request id).
+- ``MXNET_FLEET_RETRY_BUDGET``: per-request transient-retry budget for
+  router→replica dispatch (default 2; rides the fault.py
+  ``call_with_retries`` policy with full-jitter backoff).
+- ``MXNET_FLEET_PROBE_INTERVAL_MS``: router health-probe period in ms
+  (default 250; a SIGKILLed replica is detected within ~4 missed
+  probes, well under the 1s detection budget).
+- ``MXNET_FLEET_EJECT_THRESHOLD``: consecutive dispatch/probe failures
+  before the circuit breaker ejects a replica (default 3; re-admission
+  goes through bounded half-open probe traffic).
 - ``MXNET_PLANNER_MESH``: default mesh for the sharding planner
   (``auto`` or an explicit ``dp=4,tp=2`` spec — see
   :mod:`mxnet_tpu.parallel.planner`).
@@ -389,6 +405,37 @@ def serving_deadline_ms():
     """Default per-request serving deadline in ms
     (MXNET_SERVING_DEADLINE_MS, default 0 = none)."""
     return max(0, get_int("MXNET_SERVING_DEADLINE_MS", 0))
+
+
+def fleet_replicas():
+    """Serving-fleet replica count behind the router
+    (MXNET_FLEET_REPLICAS, default 2; serving/fleet)."""
+    return max(1, get_int("MXNET_FLEET_REPLICAS", 2))
+
+
+def fleet_hedge_ms():
+    """Hedged-duplicate delay floor in ms (MXNET_FLEET_HEDGE_MS,
+    default 50; the router hedges at max(floor, observed p99))."""
+    return max(0, get_int("MXNET_FLEET_HEDGE_MS", 50))
+
+
+def fleet_retry_budget():
+    """Per-request transient-retry budget for router→replica dispatch
+    (MXNET_FLEET_RETRY_BUDGET, default 2)."""
+    return max(0, get_int("MXNET_FLEET_RETRY_BUDGET", 2))
+
+
+def fleet_probe_interval_ms():
+    """Router health-probe period in ms (MXNET_FLEET_PROBE_INTERVAL_MS,
+    default 250 — four missed probes still detect a dead replica well
+    inside the 1s budget)."""
+    return max(10, get_int("MXNET_FLEET_PROBE_INTERVAL_MS", 250))
+
+
+def fleet_eject_threshold():
+    """Consecutive dispatch/probe failures before the circuit breaker
+    ejects a replica (MXNET_FLEET_EJECT_THRESHOLD, default 3)."""
+    return max(1, get_int("MXNET_FLEET_EJECT_THRESHOLD", 3))
 
 
 def planner_mesh():
@@ -722,6 +769,16 @@ def describe():
          "(default 16)"),
         ("MXNET_SERVING_DEADLINE_MS", "default per-request serving "
          "deadline in ms (default 0 = none)"),
+        ("MXNET_FLEET_REPLICAS", "serving-fleet replica count behind "
+         "the router (default 2; serving/fleet)"),
+        ("MXNET_FLEET_HEDGE_MS", "hedged-duplicate delay floor in ms "
+         "(default 50; effective delay = max(floor, observed p99))"),
+        ("MXNET_FLEET_RETRY_BUDGET", "per-request transient-retry "
+         "budget for router→replica dispatch (default 2)"),
+        ("MXNET_FLEET_PROBE_INTERVAL_MS", "router health-probe period "
+         "in ms (default 250; dead-replica detection < 1s)"),
+        ("MXNET_FLEET_EJECT_THRESHOLD", "consecutive failures before "
+         "the circuit breaker ejects a replica (default 3)"),
         ("MXNET_PLANNER_MESH", "default planner mesh: auto or "
          "\"dp=4,tp=2\"-style spec (parallel/planner)"),
         ("MXNET_PLANNER_HBM_GB", "per-device HBM budget in GiB for "

@@ -35,6 +35,19 @@ Seams (each named check-point is called on the real code path):
 ``resharding.transfer``     live-resharding transfer execution (the
                             transfer is pure w.r.t. its inputs, so a trip
                             costs one supervised retry, never torn state)
+``router.dispatch``         fleet router -> replica request transport (a
+                            trip looks like a replica-side network error;
+                            the dispatch retry/hedge/resubmit machinery
+                            absorbs it)
+``router.health_probe``     fleet router health poll of a replica (a trip
+                            counts as a missed heartbeat and drives the
+                            HEALTHY -> SUSPECT -> EJECTED state machine)
+``fleet.spawn``             replacement-replica spawn inside the fleet
+                            manager (a trip fails the spawn attempt; the
+                            manager retries under the shared policy)
+``replica.crash``           replica-side crash point checked in the fleet
+                            request loop (an armed trip kills the replica
+                            mid-request, exercising detect + resubmit)
 ==========================  =================================================
 
 Arming faults:
@@ -79,7 +92,9 @@ SEAMS = ("checkpoint.write", "checkpoint.fsync", "checkpoint.publish",
          "dataloader.worker", "kvstore.push", "kvstore.pull",
          "collectives.allreduce", "distributed.init",
          "lifecycle.sigterm", "watchdog.stall",
-         "serving.admit", "serving.decode_step", "resharding.transfer")
+         "serving.admit", "serving.decode_step", "resharding.transfer",
+         "router.dispatch", "router.health_probe", "fleet.spawn",
+         "replica.crash")
 
 _LOGGER = logging.getLogger(__name__)
 _LOCK = threading.Lock()

@@ -70,7 +70,8 @@ __all__ = ["GracefulExit", "EXIT_PREEMPTED", "EXIT_FORCED", "EXIT_STALLED",
            "coordinate_stops", "install_signal_handlers",
            "uninstall_signal_handlers", "cancel_grace_deadline",
            "publish_final_checkpoint", "note_goodput_slo_breach",
-           "note_ledger_skew",
+           "note_ledger_skew", "register_goodput_breach_hook",
+           "unregister_goodput_breach_hook", "note_fleet_queue_slo_breach",
            "capture_train_state", "restore_train_state",
            "elastic_resharder",
            "Watchdog", "start_watchdog", "stop_watchdog", "reset"]
@@ -366,6 +367,46 @@ def note_goodput_slo_breach(ratio, slo, windows):
     _flight.record_event("lifecycle", event="goodput_slo_breach",
                          ratio=float(ratio), slo=float(slo),
                          windows=int(windows))
+    for hook in list(_GOODPUT_HOOKS):
+        try:
+            hook(ratio, slo, windows)
+        except Exception:   # an observer must not break the alert path
+            _LOGGER.exception("goodput-breach hook %r failed", hook)
+
+
+# breach observers (the serving-fleet autoscaler wires scale-up here);
+# hooks run on the alerting thread and must be cheap + non-raising
+_GOODPUT_HOOKS: list = []
+
+
+def register_goodput_breach_hook(fn):
+    """Subscribe ``fn(ratio, slo, windows)`` to goodput-SLO breach
+    alerts.  The fleet autoscaler's scale-up trigger is the canonical
+    consumer — the alert stays an operator page (never a stop), and
+    hooks piggyback on it rather than re-deriving the breach."""
+    if fn not in _GOODPUT_HOOKS:
+        _GOODPUT_HOOKS.append(fn)
+    return fn
+
+
+def unregister_goodput_breach_hook(fn):
+    """Remove a breach hook (idempotent)."""
+    if fn in _GOODPUT_HOOKS:
+        _GOODPUT_HOOKS.remove(fn)
+
+
+def note_fleet_queue_slo_breach(depth, threshold, shed):
+    """Fleet-wide queue-SLO breach (the router's deadline-aware
+    shedding tripped): same contract as the goodput breach — loud log
+    + flight-recorder context event, deliberately NOT a stop.  ``shed``
+    counts the requests 429'd in this episode."""
+    _LOGGER.warning(
+        "fleet queue SLO breach: fleet-wide depth %d above threshold %d "
+        "— shedding with Retry-After (%d shed this episode)",
+        depth, threshold, shed)
+    _flight.record_event("lifecycle", event="fleet_queue_slo_breach",
+                         depth=int(depth), threshold=int(threshold),
+                         shed=int(shed))
 
 
 def note_ledger_skew(skew, threshold, windows, laggards):

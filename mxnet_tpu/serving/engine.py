@@ -258,6 +258,7 @@ class ServingEngine:
         self._warm = False
         self._joined_seq = 0
         self._latencies: deque = deque(maxlen=2048)
+        self._ttfts: deque = deque(maxlen=2048)
         self._tok_window: deque = deque(maxlen=64)   # (t, n_generated)
         self._mounted: list = []
         # fallback sampling-key chain for submitters with an UNSEEDED
@@ -614,10 +615,15 @@ class ServingEngine:
 
     # -- request surface ---------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, temperature=0.0,
-               eos_id=None, deadline_ms=None):
+               eos_id=None, deadline_ms=None, trace_id=None):
         """Enqueue a generation request; returns the Request future.
         Raises QueueFullError at the admission bound and MXNetError
-        when the server is shutting down or the prompt cannot fit."""
+        when the server is shutting down or the prompt cannot fit.
+
+        ``trace_id`` stitches cross-process traces: a fleet router
+        stamps its own (numeric) trace id into the replica request so
+        the replica-side spans land in the SAME tree the router's
+        queue_wait/dispatch spans live in."""
         if self._stop_evt.is_set():
             raise MXNetError("serving engine is shutting down")
         if not self._warm:
@@ -629,7 +635,8 @@ class ServingEngine:
         if self._trace_enabled:
             from .tracing import RequestTrace
 
-            req.trace = RequestTrace(req.id)
+            req.trace = RequestTrace(
+                int(trace_id) if trace_id is not None else req.id)
             req.trace.event("submitted", prompt_len=int(req.prompt.size),
                             max_new_tokens=req.max_new_tokens)
             req.on_resolve = self._trace_finished
@@ -733,7 +740,11 @@ class ServingEngine:
         n = self._queue.drain(lambda r: MXNetError(
             f"request {r.id} rejected: server shutting down"))
         for _ in range(n):
-            _C_REQS.labels(outcome="shutdown").inc()
+            # distinct from the in-flight work the drain COMPLETED
+            # (those finish with their normal outcome): these never ran.
+            # Fleet-level retry accounting keys on this — a
+            # drain_rejected completion is safe to resubmit elsewhere
+            _C_REQS.labels(outcome="drain_rejected").inc()
         self._publish_gauges()
 
     def _step(self):
@@ -876,6 +887,8 @@ class ServingEngine:
         if req.first_token_t is None:
             req.first_token_t = time.monotonic()
             _H_TTFT.observe(req.first_token_t - req.submitted)
+            with self._lock:
+                self._ttfts.append(req.first_token_t - req.submitted)
             if self._join_t0 is not None:
                 # replica handoff acceptance metric: donated-params
                 # join -> this replica's FIRST served token
@@ -1096,10 +1109,13 @@ class ServingEngine:
             # snapshot under the lock: the loop thread appends to the
             # deque and iterating a mutating deque raises
             lat = sorted(self._latencies)
+            ttft = sorted(self._ttfts)
+
+        def _pct_of(xs, p):
+            return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else None
 
         def pct(p):
-            return lat[min(len(lat) - 1, int(p * len(lat)))] if lat \
-                else None
+            return _pct_of(lat, p)
 
         with self._lock:
             n_exec = len(self._exec)
@@ -1115,6 +1131,10 @@ class ServingEngine:
             "warm": self._warm,
             "latency_s": {"p50": pct(0.50), "p99": pct(0.99),
                           "count": len(lat)},
+            # the fleet router's health monitor feeds on these (queue
+            # depth above + TTFT percentiles here) to score replicas
+            "ttft_s": {"p50": _pct_of(ttft, 0.50),
+                       "p99": _pct_of(ttft, 0.99), "count": len(ttft)},
             "tokens_per_s": _G_TOKS_S.value,
             "tokens_per_s_per_chip": _G_TOKS_CHIP.value,
             "context_cap": self._ctx_cap,
@@ -1169,7 +1189,8 @@ class ServingEngine:
                 max_new_tokens=int(data.get("max_new_tokens", 16)),
                 temperature=float(data.get("temperature", 0.0)),
                 eos_id=data.get("eos_id"),
-                deadline_ms=data.get("deadline_ms"))
+                deadline_ms=data.get("deadline_ms"),
+                trace_id=data.get("trace_id"))
         except QueueFullError as e:
             _C_REQS.labels(outcome="rejected").inc()
             return 429, "application/json", json.dumps(
@@ -1185,6 +1206,11 @@ class ServingEngine:
         except MXNetError as e:
             return 503, "application/json", json.dumps(
                 {"error": str(e)}).encode()
+        if data.get("return_trace") and req.trace is not None:
+            # cross-process span handoff: the caller (fleet router)
+            # grafts this replica-side tree into its own trace so
+            # /v1/requests stays end-to-end across the router hop
+            res["trace"] = req.trace.to_dict()
         return 200, "application/json", json.dumps(res).encode()
 
 

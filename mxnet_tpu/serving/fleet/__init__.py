@@ -1,0 +1,56 @@
+"""Serving fleet: a router in front of N engine replicas.
+
+The single-process :class:`~mxnet_tpu.serving.engine.ServingEngine`
+answers "how do I serve this model"; this package answers "how do I
+keep serving when a replica dies".  A router process (zero device
+work, zero jax imports — enforced by tests and the MXT110 pass) fronts
+N real engine replicas and owns:
+
+- **health** (health.py): per-replica HEALTHY → SUSPECT → EJECTED →
+  PROBING state machine fed by heartbeats, load gauges, and dispatch
+  outcomes; a circuit breaker ejects after consecutive failures and
+  re-admits through bounded half-open probe traffic.
+- **reliable dispatch** (router.py + transport.py): every request
+  carries an id and an absolute deadline; transient failures retry
+  under the shared fault.py budget; tail requests get ONE hedged
+  duplicate after a p99-derived delay with first-winner-cancels-loser
+  dedup; prompt-prefix rendezvous hashing keeps shared-prefix traffic
+  on KV-warm replicas and falls back cleanly on ejection.
+- **failure recovery**: a SIGKILLed replica is detected within one
+  probe interval; its in-flight requests are resubmitted to survivors
+  exactly once (idempotency ledger — no completion is ever delivered
+  twice); the manager spawns a warm replacement through the shared
+  compile cache / ``join_replica`` donation path.
+- **graceful degradation** (policy.py): deficit-round-robin fair-share
+  admission per tenant; deadline-aware shedding (429 + Retry-After
+  from the observed drain rate) when the fleet-wide queue breaches its
+  SLO; debounced scale-up/down hooks driven by queue and goodput
+  breaches.
+
+Chaos enters through four fault seams — ``router.dispatch``,
+``router.health_probe``, ``fleet.spawn``, ``replica.crash`` — so every
+recovery path above is exercisable deterministically in tests.
+"""
+from __future__ import annotations
+
+from .health import (EJECTED, HEALTHY, PROBING, SUSPECT, HealthMonitor,
+                     ReplicaHealth)
+from .manager import FleetManager, ProcessReplica, serve_fleet
+from .policy import (Autoscaler, FairShareQueue, HedgePolicy,
+                     SheddingPolicy, prefix_key, rendezvous_order)
+from .router import (FleetBusyError, FleetRequest, IdempotencyLedger,
+                     LocalReplica, ReplicaHandle, Router)
+from .transport import (ReplicaHTTPError, TransportError, call_local,
+                        get_json, post_json, remaining_s)
+
+__all__ = [
+    "HEALTHY", "SUSPECT", "EJECTED", "PROBING", "ReplicaHealth",
+    "HealthMonitor",
+    "FairShareQueue", "HedgePolicy", "SheddingPolicy", "Autoscaler",
+    "prefix_key", "rendezvous_order",
+    "Router", "FleetRequest", "FleetBusyError", "IdempotencyLedger",
+    "ReplicaHandle", "LocalReplica",
+    "FleetManager", "ProcessReplica", "serve_fleet",
+    "TransportError", "ReplicaHTTPError", "post_json", "get_json",
+    "call_local", "remaining_s",
+]

@@ -879,8 +879,10 @@ def register_http_route(path, handler):
     """Mount an application route on the telemetry endpoint.
 
     ``handler(method, path, query, body_bytes) -> (status, content_type,
-    body_bytes)`` is called for GET and POST requests whose path matches
-    exactly.  This is how the serving plane (:mod:`mxnet_tpu.serving`)
+    body_bytes[, headers_dict])`` is called for GET and POST requests
+    whose path matches exactly; the optional 4th element carries extra
+    response headers (the fleet router's 429 Retry-After rides it).
+    This is how the serving plane (:mod:`mxnet_tpu.serving`)
     exposes its inference API beside ``/metrics`` — one 127.0.0.1 server
     per process, one port to scrape and to query.  Routes registered
     after the server started are live immediately (the handler resolves
@@ -920,10 +922,12 @@ def start_http_server(port=None, addr="127.0.0.1"):
         port = _env.get_int("MXNET_TELEMETRY_PORT", 0)
 
     class _Handler(BaseHTTPRequestHandler):
-        def _reply(self, status, ctype, body):
+        def _reply(self, status, ctype, body, headers=None):
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(body)
 

@@ -84,14 +84,15 @@ def test_pass_catalog_complete():
                            "env-knob-registry", "fault-seam-integrity",
                            "serving-hot-path", "planner-sharding",
                            "graph-pass-contracts", "resharding-transfer",
-                           "metric-registry", "ledger-discipline"}
+                           "metric-registry", "ledger-discipline",
+                           "fleet-discipline"}
     all_codes = {c for cls in passes.values() for c in cls.codes}
     assert all_codes == {"MXT001", "MXT002", "MXT003", "MXT005",
                          "MXT006", "MXT010", "MXT020", "MXT021",
                          "MXT022", "MXT030", "MXT031", "MXT032",
                          "MXT040", "MXT050", "MXT060", "MXT070",
                          "MXT071", "MXT080", "MXT090", "MXT091",
-                         "MXT100"}
+                         "MXT100", "MXT110"}
 
 
 def test_parse_error_reported_not_fatal(tmp_path):
@@ -761,6 +762,87 @@ def test_mxt100_noqa_waiver(tmp_path):
             return body
         """)
     assert codes_at(check(tmp_path), "MXT100") == []
+
+
+# -- MXT110 fleet discipline -------------------------------------------------
+def test_mxt110_raw_transport_and_missing_deadline(tmp_path):
+    """In fleet/ outside transport.py: raw HTTP machinery is flagged,
+    as is any funnel call without an explicit deadline=; the compliant
+    twin (funnel call carrying deadline=) stays silent, and the same
+    raw import OUTSIDE fleet/ is out of scope."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/serving/fleet/rogue.py", """
+        import http.client                                  # line 1
+
+        def sneaky(host, port):
+            conn = http.client.HTTPConnection(host, port)   # line 4
+            conn.request("GET", "/v1/serving")
+            return conn.getresponse()
+
+        def lazy(replica, req):
+            from . import transport
+            return transport.post_json(                     # line 10
+                replica.host, replica.port, "/v1/completions",
+                {"prompt": req.prompt})
+
+        def compliant(replica, req):
+            from . import transport
+            return transport.post_json(
+                replica.host, replica.port, "/v1/completions",
+                {"prompt": req.prompt}, deadline=req.deadline)
+        """)
+    # raw HTTP elsewhere in the tree is not this pass's business
+    put(tmp_path, "mxnet_tpu/other.py", """
+        import http.client
+
+        def fetch(host):
+            return http.client.HTTPConnection(host)
+        """)
+    hits = codes_at(check(tmp_path), "MXT110")
+    lines = sorted(ln for p, ln in hits
+                   if p == "mxnet_tpu/serving/fleet/rogue.py")
+    assert lines == [1, 4, 10], hits
+    assert not [h for h in hits if h[0] == "mxnet_tpu/other.py"]
+
+
+def test_mxt110_funnel_file_and_jax_import(tmp_path):
+    """transport.py itself may hold the one raw-HTTP site, but a jax
+    import is flagged anywhere in fleet/ — the router plane does zero
+    device work."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/serving/fleet/transport.py", """
+        def _round_trip(host, port, deadline):
+            import http.client
+            conn = http.client.HTTPConnection(
+                host, port, timeout=deadline)
+            return conn
+
+        def get_json(host, port, path, *, deadline):
+            return _round_trip(host, port, deadline)
+        """)
+    put(tmp_path, "mxnet_tpu/serving/fleet/router.py", """
+        import jax                                          # line 1
+
+        def dispatch(replica, req):
+            from . import transport
+            return transport.get_json(
+                replica.host, replica.port, "/v1/serving",
+                deadline=req.deadline)
+        """)
+    hits = codes_at(check(tmp_path), "MXT110")
+    assert hits == [("mxnet_tpu/serving/fleet/router.py", 1)], hits
+
+
+def test_mxt110_noqa_waiver(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/serving/fleet/probe.py", """
+        def raw_healthz(host, port):
+            # mxtpu: noqa[MXT110] bootstrap probe before the funnel exists
+            import http.client
+            conn = http.client.HTTPConnection(host, port)  # mxtpu: noqa[MXT110] ditto
+            return conn
+        """)
+    assert codes_at(check(tmp_path), "MXT110") == []
 
 
 # -- MXT020-022 lock/thread hygiene -----------------------------------------

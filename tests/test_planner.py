@@ -481,6 +481,54 @@ def test_pipeline_in_jit_sharding_flag_routes_and_matches():
     assert np.allclose(out_in_jit, np.asarray(ref), atol=1e-5)
 
 
+def test_pipeline_in_jit_dp_pp_miscompile_tripwire():
+    """The reason MXNET_PLANNER_PIPELINE_IN_JIT defaults to False: on
+    a dp×pp mesh this jax's GSPMD miscompiles the in-jit ``P(pp)``
+    param specs — silently wrong numerics, no error (re-verified at
+    the 0.4.37 upgrade: max abs err ~0.5 on this repro while the
+    replicated workaround is exact).  This test pins the *bug*: the
+    workaround must stay correct, the in-jit path must stay broken.
+    The day a jax upgrade makes both paths agree here, this fails
+    loudly — flip the default to True, drop the workaround, and
+    retire this tripwire."""
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.parallel.pipeline_parallel import (pipeline_apply,
+                                                      stack_stage_params)
+
+    S, D = 2, 8
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "pp"))
+    rs = np.random.RandomState(0)
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    per = [{"w": jnp.asarray(rs.randn(D, D).astype("f") * 0.5)}
+           for _ in range(S)]
+    x = jnp.asarray(rs.randn(8, D).astype("f"))
+
+    def run(flag):
+        def f(stages, xx):
+            stacked = stack_stage_params(stages)
+            return pipeline_apply(stage_fn, stacked, xx, mesh, 4,
+                                  in_jit_sharding=flag)
+        return np.asarray(jax.jit(f)(per, x))
+
+    ref = x
+    for p in per:
+        ref = stage_fn(p, ref)
+    ref = np.asarray(ref)
+    assert np.allclose(run(False), ref, atol=1e-5)   # workaround: exact
+    err = float(np.max(np.abs(run(True) - ref)))
+    if err <= 1e-4:
+        pytest.fail(
+            "the dp×pp in-jit GSPMD miscompile appears FIXED in this "
+            f"jax build (max abs err {err:.2e}): flip the "
+            "MXNET_PLANNER_PIPELINE_IN_JIT default to True, remove the "
+            "replicated-params workaround in pipeline_parallel.py, and "
+            "delete this tripwire")
+
+
 def test_pipeline_in_jit_default_from_env():
     cfg0 = planner.PlannerConfig(mesh={"dp": 1})
     assert cfg0.pipeline_in_jit_sharding is False

@@ -9,11 +9,15 @@ serving steady-state (no traces outside AOT warmup), MXT06x sharding
 planner (no raw PartitionSpec/NamedSharding outside mxnet_tpu/parallel/),
 MXT07x graph-compiler pass contracts (purity + registration closure),
 MXT08x live-resharding transfer discipline (plans executed or
-explicitly discarded, at uniform SPMD level).
+explicitly discarded, at uniform SPMD level), MXT09x metric-catalog
+closure, MXT10x flight-recorder ledger discipline, MXT11x fleet
+dispatch discipline (one funnel, always a deadline, no jax in the
+router plane).
 """
 from . import collectives  # noqa: F401
 from . import envknobs  # noqa: F401
 from . import faultseams  # noqa: F401
+from . import fleetdiscipline  # noqa: F401
 from . import graphpass  # noqa: F401
 from . import hotpath  # noqa: F401
 from . import ledger  # noqa: F401

@@ -1,0 +1,134 @@
+"""MXT110: fleet dispatch discipline — one funnel, always a deadline.
+
+The fleet router's reliability story (ISSUE 17) hangs on a single
+choke point: every router→replica send flows through the transport
+funnel (``fleet/transport.py`` — ``post_json`` / ``get_json`` /
+``call_local``), where the ``router.dispatch`` / ``router.health_probe``
+fault seams are armed, the absolute deadline bounds the socket
+timeout, and transient failures ride the shared retry budget.  A raw
+HTTP call elsewhere in ``fleet/`` silently bypasses chaos coverage,
+deadlines, AND the circuit-breaker's failure accounting; a funnel call
+without a ``deadline`` wedges a dispatcher thread on a dead replica
+forever.  This pass keeps both halves closed as the package grows:
+
+- **Raw transport outside the funnel**: importing or calling
+  ``http.client`` / ``socket`` / ``urllib`` / ``requests`` machinery
+  anywhere in ``mxnet_tpu/serving/fleet/`` except ``transport.py``
+  (whose ``_http_round_trip`` is the one sanctioned raw-HTTP site).
+- **Funnel call without a deadline**: a ``post_json`` / ``get_json`` /
+  ``call_local`` call site with no explicit ``deadline=`` keyword.
+  Splatted ``**kwargs`` do not count — the deadline must be visible at
+  the call site, same spirit as MXT040's literal-seam rule.
+- **jax in the router plane**: any ``import jax`` under ``fleet/``.
+  The router does zero device work by design — a jax import is how
+  "zero" quietly becomes "some" (device init, tracer state, a second
+  process fighting the replicas for the TPU).
+
+Waive a deliberate exception inline with a reason:
+``# mxtpu: noqa[MXT110] <why this site is outside the contract>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name
+from ..core import Finding, Pass, register
+
+_FLEET_PREFIX = "mxnet_tpu/serving/fleet/"
+_FUNNEL_FILE = _FLEET_PREFIX + "transport.py"
+_FUNNEL_CALLS = {"post_json", "get_json", "call_local"}
+
+# module roots whose presence in fleet/ means raw-wire traffic
+_RAW_ROOTS = {"socket", "http", "urllib", "urllib2", "urllib3",
+              "requests", "httplib"}
+# call-name fragments that are raw-wire even via indirect aliasing
+_RAW_CALL_TAILS = {"HTTPConnection", "HTTPSConnection", "urlopen",
+                   "create_connection"}
+
+
+def _root(name):
+    return (name or "").split(".", 1)[0]
+
+
+@register
+class FleetDiscipline(Pass):
+    name = "fleet-discipline"
+    codes = {"MXT110": "fleet dispatch outside the deadline-carrying "
+                       "transport funnel"}
+
+    def run(self, ctx, mod):
+        if not mod.relpath.startswith(_FLEET_PREFIX):
+            return []
+        is_funnel = mod.relpath == _FUNNEL_FILE
+        findings = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                findings.extend(self._check_import(mod, node, is_funnel))
+            elif isinstance(node, ast.Call):
+                findings.extend(self._check_call(mod, node, is_funnel))
+        return findings
+
+    def _check_import(self, mod, node, is_funnel):
+        roots = []
+        if isinstance(node, ast.Import):
+            roots = [a.name for a in node.names]
+        elif node.module and node.level == 0:
+            roots = [node.module]
+        out = []
+        for name in roots:
+            root = _root(name)
+            if root == "jax":
+                out.append(Finding(
+                    code="MXT110", path=mod.relpath, line=node.lineno,
+                    message=f"import {name}: jax in the fleet router "
+                            "plane (the router does zero device work)",
+                    hint="keep device work on the replicas; the router "
+                         "only moves JSON and reads health records",
+                    scope=mod.qualname(node), key="fleet-jax-import",
+                    col=node.col_offset))
+            elif root in _RAW_ROOTS and not is_funnel:
+                out.append(Finding(
+                    code="MXT110", path=mod.relpath, line=node.lineno,
+                    message=f"import {name}: raw transport outside the "
+                            "fleet funnel (transport.py)",
+                    hint="send through transport.post_json/get_json/"
+                         "call_local — they arm the router.dispatch/"
+                         "health_probe seams, bound the socket timeout "
+                         "by the request deadline, and feed the circuit "
+                         "breaker's failure accounting",
+                    scope=mod.qualname(node), key="fleet-raw-transport",
+                    col=node.col_offset))
+        return out
+
+    def _check_call(self, mod, node, is_funnel):
+        name = call_name(node)
+        if name is None:
+            return []
+        tail = name.rsplit(".", 1)[-1]
+        scope = mod.qualname(node)
+        if tail in _FUNNEL_CALLS:
+            if any(kw.arg == "deadline" for kw in node.keywords):
+                return []
+            return [Finding(
+                code="MXT110", path=mod.relpath, line=node.lineno,
+                message=f"{name}() without an explicit deadline= "
+                        f"({scope})",
+                hint="every fleet dispatch carries an absolute "
+                     "monotonic deadline — without one a dispatcher "
+                     "thread can wedge forever on a dead replica; "
+                     "pass deadline= visibly at the call site "
+                     "(**kwargs splat does not satisfy the contract)",
+                scope=scope, key="fleet-no-deadline",
+                col=node.col_offset)]
+        if not is_funnel and (tail in _RAW_CALL_TAILS
+                              or _root(name) in _RAW_ROOTS):
+            return [Finding(
+                code="MXT110", path=mod.relpath, line=node.lineno,
+                message=f"{name}(): raw transport outside the fleet "
+                        f"funnel ({scope})",
+                hint="route through transport.post_json/get_json/"
+                     "call_local (the seam-wrapped, deadline-bounded "
+                     "choke point)",
+                scope=scope, key="fleet-raw-transport",
+                col=node.col_offset)]
+        return []
