@@ -1443,6 +1443,94 @@ def bench_observability():
     return out
 
 
+def bench_guard(steps=30, warmup=5):
+    """Numerical-integrity guard A/B (ISSUE 20).
+
+    Arm-alternating guard-on vs guard-off training steps/s on a small
+    MLP — the same discipline as the tracing/flight-recorder A/Bs above
+    (interleaved arms, best-of-2, so scheduler drift hits both arms
+    equally).  The guard's contract is ONE fused sentinel reduction +
+    ONE host sync per step over values the step already computes, so
+    the throughput ratio must land within noise AND the compile-event
+    counter must stay flat across both measured arms (the sentinel
+    introduces no new traced program).
+    """
+    import time
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, telemetry
+    from mxnet_tpu import guard as guard_mod
+
+    X = np.random.RandomState(11).randn(32, 16).astype("f")
+    Y = (X.sum(1) > 0).astype("f")
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def build(guarded):
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(64, in_units=16, activation="relu"),
+                gluon.nn.Dense(2, in_units=64))
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05})
+        if guarded:
+            guard_mod.attach(trainer, guard=guard_mod.Guard(window=32))
+        return net, trainer
+
+    def compile_count():
+        fam = telemetry.snapshot()["metrics"].get(
+            "mxnet_compile_events_total")
+        if not fam or not fam["samples"]:
+            return 0.0
+        return sum(s["value"] for s in fam["samples"])
+
+    def run_arm(guarded):
+        net, trainer = build(guarded)
+        xs, ys = nd.array(X), nd.array(Y)
+
+        def one_step():
+            with autograd.record():
+                loss = lf(net(xs), ys)
+            loss.backward()
+            trainer.step(X.shape[0])
+            return loss
+
+        for _ in range(warmup):
+            one_step()
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(steps):
+            last = one_step()
+        np.asarray(last._get())      # settle the tail before stamping
+        return steps / (time.perf_counter() - t0)
+
+    # warm every trace in BOTH arms before measuring, so the measured
+    # arms read pure steady state and the compile counter can be
+    # asserted flat over them
+    run_arm(False)
+    run_arm(True)
+    c0 = compile_count()
+    on, off = [], []
+    for _ in range(2):
+        off.append(run_arm(False))
+        on.append(run_arm(True))
+    compile_delta = compile_count() - c0
+    ratio = max(on) / max(off) if max(off) else 1.0
+    return {
+        "steps_per_s_guard_on": round(max(on), 2),
+        "steps_per_s_guard_off": round(max(off), 2),
+        "ratio": round(ratio, 3),
+        # one fused sync per step is the design; anything beyond ~20%
+        # on this CPU microbench is a regression, not noise
+        "within_noise": bool(ratio >= 0.8),
+        "compile_events_measured_arms": compile_delta,
+        "compile_flat": bool(compile_delta == 0),
+    }
+
+
 def _probe_backend(timeout=90, retries=2):
     """Initialize the backend in a SUBPROCESS first, with a timeout.
 
@@ -1861,6 +1949,14 @@ def main():
         extra["fleet"] = bench_fleet()
     except Exception as e:
         extra["fleet"] = {"error": repr(e)[:200]}
+    try:
+        # numerical-integrity guard (ISSUE 20): arm-alternating A/B —
+        # guard-on vs guard-off steps/s within noise (one fused
+        # sentinel sync per step) with the compile counter flat over
+        # the measured arms
+        extra["guard"] = bench_guard()
+    except Exception as e:
+        extra["guard"] = {"error": repr(e)[:200]}
     try:
         # BASELINE binding metric: allreduce bandwidth (tools/bandwidth_
         # measure.py ≙ reference tools/bandwidth/measure.py).  The bus

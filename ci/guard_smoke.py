@@ -1,0 +1,245 @@
+"""Chaos-lane smoke for the numerical-integrity guard (ISSUE 20).
+
+Run by ci/runtest.sh chaos as:
+
+    JAX_PLATFORMS=cpu python ci/guard_smoke.py
+
+Proves the acceptance shape end to end, on the public surface:
+
+(a) **NaN-skip bit-identical rejoin** — a guarded run with a NaN
+    gradient injected mid-run zeroes exactly that update and thereafter
+    bit-matches a clean run that omitted the same step; a guarded CLEAN
+    run bit-matches the unguarded run (the gate adds no numerics) and
+    performs ZERO fresh traces beyond the unguarded steady state
+    (compile tracer asserted flat — the sentinel is a fused reduction
+    over values the step already computes).
+
+(b) **SDC blame + rewind** — three simulated ranks stamp post-allreduce
+    bucket checksums (rank 2 holds corrupted bytes); the merged black
+    boxes AND the offline ``teldump blame`` re-merge emit a
+    ``numerical_divergence`` verdict naming rank 2 at the exact step;
+    the canary vote raises :class:`NumericalDivergence` naming the
+    minority; the remediation ladder rewinds a drifted model back to
+    the last valid checkpoint, and ``run_with_recovery`` charges a
+    guard-verdict failure to the ``rewind`` goodput bucket.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+# the script lives in ci/; the repo root is the import root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_FAULT_BACKOFF_MS", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, flight_recorder, gluon, nd  # noqa: E402
+from mxnet_tpu import guard as guard_mod  # noqa: E402
+from mxnet_tpu import telemetry, telemetry_agg  # noqa: E402
+from mxnet_tpu.checkpoint import CheckpointManager, run_with_recovery  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STEPS = 6
+X = np.random.RandomState(7).randn(16, 4).astype("f")
+Y = (X.sum(1) > 0).astype("f")
+
+
+def _net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=4, activation="relu"),
+            gluon.nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _backward(net):
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = lf(net(nd.array(X)), nd.array(Y))
+    loss.backward()
+
+
+def _run(guard=None, poison_at=None, omit_at=None):
+    """One deterministic training run; returns the final weights."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = _net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    if guard is not None:
+        guard_mod.attach(trainer, guard=guard)
+    for i in range(STEPS):
+        _backward(net)
+        if i == poison_at:
+            p = list(net.collect_params().values())[0]
+            g = p.grad()
+            g._set(g._get() * np.nan)
+        if i == omit_at:
+            continue            # the reference simply never applies it
+        trainer.step(16)
+    return [p.data().asnumpy().copy()
+            for p in net.collect_params().values()]
+
+
+def _same(a, b):
+    return all(np.array_equal(x, y) for x, y in zip(a, b))
+
+
+def _counter(name):
+    fam = telemetry.snapshot()["metrics"].get(name)
+    if not fam or not fam["samples"]:
+        return 0.0
+    return sum(s["value"] for s in fam["samples"])
+
+
+def smoke_nan_skip_rejoin():
+    # determinism baseline, and warm every trace so the compile tracer
+    # reads steady state
+    clean = _run()
+    assert _same(clean, _run()), "unguarded runs must be deterministic"
+
+    c0 = _counter("mxnet_compile_events_total")
+    clean2 = _run()
+    c_off = _counter("mxnet_compile_events_total") - c0
+    guarded = _run(guard=guard_mod.Guard(window=16))
+    c_on = _counter("mxnet_compile_events_total") - c0 - c_off
+    assert _same(clean, clean2)
+    assert _same(clean, guarded), \
+        "guard-on clean trajectory must bit-match guard-off"
+    assert c_on == c_off == 0, \
+        f"guard must add ZERO fresh traces (off={c_off} on={c_on})"
+
+    skips0 = _counter("mxnet_guard_skips_total")
+    poisoned = _run(guard=guard_mod.Guard(window=16), poison_at=3)
+    assert _counter("mxnet_guard_skips_total") - skips0 == 1
+    reference = _run(omit_at=3)
+    assert _same(poisoned, reference), \
+        "the skipped trajectory must rejoin the omit-step run bit-exactly"
+    print(f"guard_smoke OK: NaN at step 3 skipped, trajectory rejoined "
+          f"bit-identically; clean guard-on == guard-off, compile "
+          f"events flat (off=+{c_off} on=+{c_on})")
+
+
+def smoke_checksum_blame(tmpdir):
+    key = "__grad_bucket0g1"
+    for r in (0, 1, 2):
+        flight_recorder.reset()
+        flight_recorder.configure(capacity=64, rank=r, world=3)
+        payload = np.arange(64, dtype="f")
+        if r == 2:
+            payload[7] += 1e-3          # one flipped value: SDC on rank 2
+        guard_mod.stamp_bucket_checksum(key, payload, step=184)
+        assert flight_recorder.dump_blackbox(
+            "numerical_divergence", directory=tmpdir) is not None
+    flight_recorder.reset()
+
+    boxes = telemetry_agg.read_blackboxes(tmpdir)
+    assert sorted(boxes) == [0, 1, 2]
+    v = telemetry_agg.merge_blackboxes(boxes)["verdict"]
+    assert v["kind"] == "numerical_divergence", v
+    assert v["ranks"] == [2] and v["step"] == 184 and v["tag"] == key, v
+
+    # the offline re-merge must say the same thing
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.teldump", "blame", tmpdir],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    assert "NUMERICAL_DIVERGENCE" in r.stdout, r.stdout
+    assert "step   184" in r.stdout and "[2]" in r.stdout, r.stdout
+    print(f"guard_smoke OK: checksum divergence blamed rank "
+          f"{v['ranks']} at step {v['step']} ({key}); offline teldump "
+          f"re-merge agrees")
+
+
+def smoke_canary_vote():
+    from mxnet_tpu.parallel import collectives
+
+    orig = collectives.allreduce_hosts
+    collectives.allreduce_hosts = \
+        lambda value, _testing_force=False: np.array([5.0, 9.0, 5.0], "f")
+    try:
+        gd = guard_mod.Guard(window=16, _testing_force=True)
+        try:
+            gd.canary(lambda: np.ones(4, dtype="f"), step=7)
+        except guard_mod.NumericalDivergence as e:
+            assert e.ranks == (1,), e.ranks
+        else:
+            raise AssertionError("minority digest must raise")
+    finally:
+        collectives.allreduce_hosts = orig
+    print("guard_smoke OK: canary vote named minority rank (1,) and "
+          "raised NumericalDivergence uniformly")
+
+
+def smoke_rewind(tmpdir):
+    np.random.seed(1)
+    mx.random.seed(1)
+    net = _net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    for _ in range(2):
+        _backward(net)
+        trainer.step(16)
+    mgr = CheckpointManager(os.path.join(tmpdir, "ckpt"))
+    mgr.save(2, net, trainer)
+    want = net(nd.array(X)).asnumpy().copy()
+    _backward(net)
+    trainer.step(16)                    # drift past the checkpoint
+    assert not np.allclose(net(nd.array(X)).asnumpy(), want)
+
+    guard_mod.attach(trainer,
+                     guard=guard_mod.Guard(window=16, rewind_after=1),
+                     manager=mgr, net=net)
+    _backward(net)
+    p = list(net.collect_params().values())[0]
+    g = p.grad()
+    g._set(g._get() * np.nan)
+    rewinds0 = _counter("mxnet_guard_rewinds_total")
+    trainer.step(16)                    # anomaly -> ladder -> rewind
+    assert _counter("mxnet_guard_rewinds_total") - rewinds0 == 1
+    got = net(nd.array(X)).asnumpy()
+    assert np.allclose(got, want, rtol=1e-6), \
+        "rewind must restore the last valid checkpoint"
+
+    # a guard-verdict failure under supervision lands in the `rewind`
+    # goodput bucket, not `restart`
+    telemetry.reset()
+    attempts = []
+
+    def train(start, manager):
+        attempts.append(start)
+        if len(attempts) == 1:
+            raise guard_mod.GuardRewind("persistent grad_anomaly")
+        return "done"
+
+    assert run_with_recovery(train, mgr, max_restarts=2) == "done"
+    buckets = telemetry.goodput_summary()["buckets"]
+    assert buckets.get("rewind", 0) > 0, buckets
+    print(f"guard_smoke OK: ladder rewound to step 2 "
+          f"(latest_valid_step), supervised guard failure charged "
+          f"rewind={buckets['rewind']:.4f}s")
+
+
+def main():
+    smoke_nan_skip_rejoin()
+    with tempfile.TemporaryDirectory(prefix="guard_smoke_") as d:
+        smoke_checksum_blame(d)
+    smoke_canary_vote()
+    with tempfile.TemporaryDirectory(prefix="guard_smoke_") as d:
+        smoke_rewind(d)
+    print("guard_smoke: ALL OK")
+
+
+if __name__ == "__main__":
+    main()

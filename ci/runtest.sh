@@ -17,6 +17,9 @@
 #               and beats cold restart-to-first-step) + black-box
 #               flight-recorder smoke (SIGSTOP'd child -> merged
 #               hang-blame verdict naming the wedged collective)
+#               + numerical-integrity guard smoke (NaN skip with
+#               bit-identical rejoin, SDC checksum/canary blame,
+#               ladder rewind to the last valid checkpoint)
 #   telemetry   runtime-telemetry smoke (train loop with telemetry +
 #               profiler on; Prometheus/snapshot/compile-event checks)
 #               + the telemetry unit suite
@@ -118,17 +121,25 @@ case "$LANE" in
     #    collective tag, sequence number, and the frozen rank — with
     #    the offline `teldump blame` re-merge bit-matching the live one
     JAX_PLATFORMS=cpu python ci/blackbox_smoke.py
-    # 5) the fault suite incl. slow scenarios (real SIGKILL of a worker).
+    # 5) numerical-integrity guard (ISSUE 20): injected NaN gradient
+    #    mid-run is skipped and the trajectory rejoins a clean run
+    #    bit-identically (guard-on clean == guard-off, zero fresh
+    #    traces); persistent rank-local corruption -> minority rank
+    #    blamed by checksum/canary vote (numerical_divergence in the
+    #    offline teldump re-merge) and the ladder rewinds to the last
+    #    valid checkpoint
+    JAX_PLATFORMS=cpu python ci/guard_smoke.py
+    # 6) the fault suite incl. slow scenarios (real SIGKILL of a worker).
     #    The unit lane also runs this file; the repeat is deliberate —
     #    the chaos stage must stay green/triagable on its own (ISSUE 2)
     #    and is cheap (~20s).  test_checkpoint.py is NOT repeated.
     JAX_PLATFORMS=cpu python -m pytest -q tests/test_fault.py
-    # 6) the fleet suite incl. the slow real-engine integration tests
+    # 7) the fleet suite incl. the slow real-engine integration tests
     #    the unit tier's `-m 'not slow'` filter skips (router parity +
     #    grafted traces, replica.crash chaos, warm join_replica heal,
     #    HTTP front door)
     JAX_PLATFORMS=cpu python -m pytest -q tests/test_fleet.py
-    # 7) serving fleet (ISSUE 17): router + 3 REAL engine processes
+    # 8) serving fleet (ISSUE 17): router + 3 REAL engine processes
     #    over a shared compile cache, SIGKILL one mid-load — zero
     #    lost/duplicated completions, kill-phase TTFT p99 within 2x the
     #    healthy baseline, and the auto-heal replacement must join WARM

@@ -684,6 +684,7 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
     last_live_step = None
     live_start = None
     fail_t = None          # goodput: failure -> next attempt downtime
+    fail_bucket = "restart"  # or "rewind" for guard-verdict failures
     reshard_dt = 0.0       # resharder time inside that window (charged
     #                        to the reshard bucket by apply_transfer)
     while True:
@@ -693,11 +694,14 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
             # restart downtime: everything between the failure and this
             # re-attempt (join, progress probe, backoff sleep) except
             # the live-reshard transfer, which the resharding seam
-            # already charged to its own bucket
+            # already charged to its own bucket.  A numerical-integrity
+            # failure (guard rewind/divergence) charges the ``rewind``
+            # bucket instead: time lost to wrong VALUES, not to a lost
+            # process — the distinction an SLO postmortem needs
             telemetry.goodput_note(
-                "restart",
+                fail_bucket,
                 max(0.0, time.perf_counter() - fail_t - reshard_dt))
-            fail_t, reshard_dt = None, 0.0
+            fail_t, fail_bucket, reshard_dt = None, "restart", 0.0
         try:
             result = train_fn(start, manager)
             # a final async save may still be staging: join before the
@@ -732,6 +736,11 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
             if should_retry is not None and not should_retry(e):
                 raise
             fail_t = time.perf_counter()
+            from . import guard as _guard
+
+            divergence = isinstance(e, _guard.NumericalDivergence)
+            if divergence or isinstance(e, _guard.GuardRewind):
+                fail_bucket = "rewind"
             # black-box first, while the ring still holds the failing
             # step's collectives: the dump is atomic and per-rank (the
             # mesh may be mid-desync — NEVER a collective here), and a
@@ -739,7 +748,8 @@ def run_with_recovery(train_fn, manager, max_restarts=3,
             # abnormal event on record
             _flight.record_event("lifecycle", event="train_failure",
                                  error=repr(e)[:200])
-            _flight.dump_blackbox("run_with_recovery_failure")
+            _flight.dump_blackbox("numerical_divergence" if divergence
+                                  else "run_with_recovery_failure")
             # a background checkpoint write may still be in flight from
             # before the failure: let it finish (it may publish the step
             # that resets the budget) before judging progress — a FAILED

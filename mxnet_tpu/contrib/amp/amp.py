@@ -119,10 +119,20 @@ def _cast_scope(target_dtype=_DEFAULT_TARGET, target_dtype_ops=None,
 def init_trainer(trainer, loss_scaler=None):
     """Attach dynamic loss scaling to a Gluon Trainer (reference:
     amp.init_trainer).  The trainer's step() gains overflow-skip semantics:
-    non-finite scaled gradients skip the update and shrink the scale."""
+    non-finite scaled gradients skip the update and shrink the scale.
+
+    Composes with the numerical-integrity guard: ``guard.attach`` must
+    come AFTER init_trainer (the guard's unified step then owns both the
+    verdict and the loss-scale bookkeeping, one host sync total) —
+    wrapping an already-guarded trainer would re-split the sync."""
     st = _amp_dict()
     if not st["on"]:
         raise MXNetError("call amp.init() before amp.init_trainer()")
+    if getattr(trainer, "_guard", None) is not None:
+        raise MXNetError(
+            "amp.init_trainer on a guard-attached trainer: attach order "
+            "is amp first, then guard.attach (the guard step subsumes "
+            "the AMP overflow sync)")
     if loss_scaler is None:
         loss_scaler = LossScaler(dynamic=(st["target"] == "float16"))
     trainer._amp_loss_scaler = loss_scaler

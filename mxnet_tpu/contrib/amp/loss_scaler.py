@@ -36,22 +36,18 @@ class LossScaler:
 
         In a multi-process job the verdict is agreed across all processes
         (logical-or via a host allreduce): a process-local skip would desync
-        the replicas' weights and loss scales."""
-        import jax
-        import jax.numpy as jnp
+        the replicas' weights and loss scales.
 
-        total = None
-        for p in params:
-            if p.grad_req == "null" or p._data is None:
-                continue
-            for g in p.list_grad():
-                v = g._get()
-                if not jnp.issubdtype(v.dtype, jnp.floating):
-                    continue
-                # count (not any()): sums of non-negative counts keep the
-                # > 0 verdict exact under float32 accumulation
-                bad = jnp.sum(~jnp.isfinite(v)).astype(jnp.float32)
-                total = bad if total is None else total + bad
+        The fused reduction itself lives in ``guard.nonfinite_total`` —
+        the numerical-integrity guard generalized it into the per-step
+        sentinel vector, and both callers share ONE source so the AMP
+        overflow verdict and the guard's ``nonfinite`` verdict can never
+        disagree (the parity test pins this)."""
+        import jax
+
+        from ...guard import nonfinite_total
+
+        total = nonfinite_total(params)
         if total is None:
             return False
         if jax.process_count() > 1:

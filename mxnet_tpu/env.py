@@ -644,6 +644,74 @@ def trace_keep_slowest():
     return max(1, get_int("MXNET_TRACE_KEEP_SLOWEST", 16))
 
 
+def guard_enabled():
+    """Numerical-integrity guard master gate (MXNET_GUARD, default 0;
+    mxnet_tpu/guard.py — the fused sentinel check + skip/rewind
+    remediation ladder)."""
+    return get_bool("MXNET_GUARD", False)
+
+
+def guard_window():
+    """Trailing robust-window length for the guard's loss/grad-norm
+    spike baselines and the anomaly counter (MXNET_GUARD_WINDOW,
+    default 64 steps)."""
+    return max(8, get_int("MXNET_GUARD_WINDOW", 64))
+
+
+def guard_loss_spike():
+    """Robust-z threshold above the window median that classifies a
+    loss as loss_spike (MXNET_GUARD_LOSS_SPIKE, default 10.0;
+    <= 0 disables the loss-spike sentinel)."""
+    return get_float("MXNET_GUARD_LOSS_SPIKE", 10.0)
+
+
+def guard_grad_spike():
+    """Robust-z threshold above the window median that classifies a
+    global grad-norm as grad_anomaly (MXNET_GUARD_GRAD_SPIKE,
+    default 10.0; <= 0 disables the grad-anomaly sentinel)."""
+    return get_float("MXNET_GUARD_GRAD_SPIKE", 10.0)
+
+
+def guard_skip():
+    """Skip-step tier of the remediation ladder: zero the update on an
+    anomalous verdict (MXNET_GUARD_SKIP, default 1; 0 = verdict-only
+    observation mode, updates always commit)."""
+    return get_bool("MXNET_GUARD_SKIP", True)
+
+
+def guard_rewind_after():
+    """Anomalous verdicts within the trailing window before the ladder
+    escalates from skip to a latest-valid-checkpoint rewind
+    (MXNET_GUARD_REWIND_AFTER, default 0 = rewind tier off; needs
+    Guard.bind_rewind)."""
+    return max(0, get_int("MXNET_GUARD_REWIND_AFTER", 0))
+
+
+def guard_sync_every():
+    """Issue the guard's agreement collective + host sync every N-th
+    check (MXNET_GUARD_SYNC_EVERY, default 1 = every guarded step;
+    off-cycle checks return the last agreed verdict — anomaly latency
+    grows to at most N steps, the MXNET_STOP_SYNC_EVERY shape)."""
+    return max(1, get_int("MXNET_GUARD_SYNC_EVERY", 1))
+
+
+def guard_checksum():
+    """Quarantine tier: stamp post-allreduce per-bucket checksums into
+    the flight recorder for offline cross-rank SDC blame
+    (MXNET_GUARD_CHECKSUM, default 0; independent of MXNET_GUARD so
+    evidence collection can be armed without changing step
+    semantics)."""
+    return get_bool("MXNET_GUARD_CHECKSUM", False)
+
+
+def guard_canary_every():
+    """Deterministic canary-microbatch recompute + cross-rank digest
+    vote every N guarded steps (MXNET_GUARD_CANARY_EVERY, default 0 =
+    canary off; a minority digest raises NumericalDivergence on every
+    rank)."""
+    return max(0, get_int("MXNET_GUARD_CANARY_EVERY", 0))
+
+
 def device_peak_flops_override():
     """Manual per-device peak FLOP/s for online MFU accounting
     (MXNET_DEVICE_PEAK_FLOPS, default 0 = use the TPU device-kind
@@ -730,6 +798,29 @@ def describe():
         ("MXNET_DEVICE_PEAK_FLOPS", "per-device peak FLOP/s override "
          "for online MFU (default 0 = TPU device-kind table; "
          "mxnet_tpu/introspection.py)"),
+        ("MXNET_GUARD", "numerical-integrity guard: fused sentinel "
+         "check + skip/rewind ladder (default 0; mxnet_tpu/guard.py)"),
+        ("MXNET_GUARD_WINDOW", "trailing robust-window length for the "
+         "guard's spike baselines and anomaly counter (default 64)"),
+        ("MXNET_GUARD_LOSS_SPIKE", "robust-z loss-spike threshold over "
+         "the window median (default 10.0; <= 0 = sentinel off)"),
+        ("MXNET_GUARD_GRAD_SPIKE", "robust-z grad-norm anomaly "
+         "threshold over the window median (default 10.0; <= 0 = "
+         "sentinel off)"),
+        ("MXNET_GUARD_SKIP", "skip-step tier: zero the update on an "
+         "anomalous verdict (default 1; 0 = observe only)"),
+        ("MXNET_GUARD_REWIND_AFTER", "anomalies in the window before "
+         "skip escalates to a latest-valid-checkpoint rewind "
+         "(default 0 = rewind tier off)"),
+        ("MXNET_GUARD_SYNC_EVERY", "guard agreement collective + host "
+         "sync every N-th check (default 1; off-cycle returns the "
+         "last agreed verdict)"),
+        ("MXNET_GUARD_CHECKSUM", "quarantine tier: post-allreduce "
+         "per-bucket checksum stamps for offline SDC blame "
+         "(default 0)"),
+        ("MXNET_GUARD_CANARY_EVERY", "deterministic canary recompute + "
+         "cross-rank digest vote every N guarded steps (default 0 = "
+         "off; minority digest raises NumericalDivergence)"),
         ("MXNET_PREFETCH_BUFFER", "device-prefetch queue depth "
          "(default 2; 0 = no background pipeline; "
          "gluon/data/prefetcher.py)"),

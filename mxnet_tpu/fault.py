@@ -48,6 +48,18 @@ Seams (each named check-point is called on the real code path):
 ``replica.crash``           replica-side crash point checked in the fleet
                             request loop (an armed trip kills the replica
                             mid-request, exercising detect + resubmit)
+``guard.check``             numerical-integrity sentinel check (a trip
+                            surfaces before the verdict collective, so no
+                            peer is left waiting on a half-issued
+                            agreement)
+``guard.rewind``            guard remediation rewind to the latest valid
+                            checkpoint (a trip leaves the run on its
+                            current state; the next anomalous verdict
+                            re-triggers)
+``guard.canary``            deterministic canary-microbatch recompute +
+                            cross-rank digest vote (checked before the
+                            recompute — a trip skips this vote round
+                            uniformly)
 ==========================  =================================================
 
 Arming faults:
@@ -94,7 +106,7 @@ SEAMS = ("checkpoint.write", "checkpoint.fsync", "checkpoint.publish",
          "lifecycle.sigterm", "watchdog.stall",
          "serving.admit", "serving.decode_step", "resharding.transfer",
          "router.dispatch", "router.health_probe", "fleet.spawn",
-         "replica.crash")
+         "replica.crash", "guard.check", "guard.rewind", "guard.canary")
 
 _LOGGER = logging.getLogger(__name__)
 _LOCK = threading.Lock()

@@ -30,8 +30,13 @@ Design (all host-side, zero device work, zero host syncs):
   *before* a hang.
 - **Context events** ride the same ring: step boundaries
   (telemetry.step_begin/step_end), fault-seam trips, compile events,
-  and lifecycle transitions (stop requests, restarts, SLO breaches) —
-  the "what was the job doing" context around the last collective.
+  lifecycle transitions (stop requests, restarts, SLO breaches), and
+  the numerical-integrity guard's evidence stamps
+  (``guard_checksum`` post-allreduce bucket digests, ``guard_canary``
+  recompute digests, verdicts/skips/rewinds — mxnet_tpu/guard.py;
+  the digests are what ``merge_blackboxes`` turns into a
+  ``numerical_divergence`` blame verdict) — the "what was the job
+  doing" context around the last collective.
 - **Black-box dumps**: on any abnormal exit (watchdog stall,
   ``run_with_recovery`` failure, forced grace-deadline exit, unhandled
   exception in the TrainStep/serving loops) each rank atomically writes

@@ -13,6 +13,7 @@ instead — Trainer remains the imperative-compatible surface.
 from __future__ import annotations
 
 from ..base import MXNetError
+from .. import guard as _guard
 from .. import optimizer as opt_mod
 from .. import kvstore as kvs_mod
 from .. import telemetry as _telemetry
@@ -290,6 +291,12 @@ class Trainer:
             # would duplicate the whole dense-grad footprint in HBM
             self._kvstore._discard_transient(key)
             _bucketing.record_fused(b.nbytes)
+            if _guard.checksum_enabled():
+                # quarantine evidence: the reduced flat is bit-identical
+                # on every rank by construction, so its digest diverging
+                # across the merged black boxes is proof of SDC/desync
+                _guard.stamp_bucket_checksum(key, flats[0]._get(),
+                                             step=self.step_count)
             for j in range(ndev):
                 for i, part in zip(b.keys,
                                    _bucketing.unpack(b, flats[j]._get())):
@@ -315,6 +322,12 @@ class Trainer:
         w_flat = _bucketing.pack([self._params[i].list_data()[0]._get()
                                   for i in b.keys])
         new_flat = engine.step_bucket(("gen", gen), b, flats, w_flat)
+        if _guard.checksum_enabled():
+            # post all-gather the updated flat weight is bit-identical
+            # across ranks — same quarantine evidence as the fused path
+            _guard.stamp_bucket_checksum(
+                f"__zero_bucket{b.index}g{gen}", new_flat,
+                step=self.step_count)
         for i, part in zip(b.keys, _bucketing.unpack(b, new_flat)):
             param = self._params[i]
             nd_part = NDArray._from_jax(part)

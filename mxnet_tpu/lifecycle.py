@@ -448,7 +448,7 @@ def publish_final_checkpoint(manager, step, net=None, trainer=None,
 
 
 def capture_train_state(step=None, dataloader=None, scaler=None,
-                        trainer=None, extra=None):
+                        trainer=None, extra=None, guard=None):
     """Bundle everything beyond weights/optimizer-state that a
     bit-identical resume needs, as a JSON-able dict for
     ``CheckpointManager.save(..., train_state=...)``:
@@ -459,6 +459,9 @@ def capture_train_state(step=None, dataloader=None, scaler=None,
     - LossScaler scale + clean-step counter (``scaler``),
     - the Trainer's optimizer update count (``trainer`` — redundant with
       the pickled optimizer in trainer.states, kept as a cross-check),
+    - the numerical-integrity guard's trailing window + ladder state
+      (``guard`` — a resumed run classifies its next step exactly as
+      the original would have; mxnet_tpu/guard.py),
     - caller extras (``extra``; must be JSON-able).
 
     Capture at a step boundary, on the training thread (the RNG state is
@@ -476,16 +479,18 @@ def capture_train_state(step=None, dataloader=None, scaler=None,
         st["loss_scaler"] = scaler.state_dict()
     if trainer is not None:
         st["trainer"] = {"num_update": int(trainer.step_count)}
+    if guard is not None:
+        st["guard"] = guard.state_dict()
     if extra:
         st["extra"] = extra
     return st
 
 
-def restore_train_state(state, dataloader=None, scaler=None):
+def restore_train_state(state, dataloader=None, scaler=None, guard=None):
     """Re-apply a ``capture_train_state`` dict (RNG always; DataLoader /
-    LossScaler when passed).  Returns the recorded step (or None).  The
-    DataLoader fast-forwards decode-free on its next ``__iter__`` —
-    skipped batches never touch the dataset."""
+    LossScaler / guard when passed).  Returns the recorded step (or
+    None).  The DataLoader fast-forwards decode-free on its next
+    ``__iter__`` — skipped batches never touch the dataset."""
     if not state:
         return None
     from . import random as _random
@@ -496,6 +501,8 @@ def restore_train_state(state, dataloader=None, scaler=None):
         dataloader.load_state_dict(state["dataloader"])
     if scaler is not None and state.get("loss_scaler") is not None:
         scaler.load_state_dict(state["loss_scaler"])
+    if guard is not None and state.get("guard") is not None:
+        guard.load_state_dict(state["guard"])
     return state.get("step")
 
 

@@ -243,7 +243,10 @@ def _combine_with_seam(local_leaves, combine_fn, static_args=(),
 def allreduce_hosts(value, _testing_force=False):
     """Allreduce a host-local array across all processes' devices: builds a
     global array sharded over processes and psums it.  Used by the
-    dist_tpu_sync KVStore (single psum ≙ push+pull, SURVEY.md §4.4).
+    dist_tpu_sync KVStore (single psum ≙ push+pull, SURVEY.md §4.4), and
+    by the numerical-integrity guard as its verdict-agreement primitive
+    (one summed sentinel vector / one-hot canary-digest table per check;
+    mxnet_tpu/guard.py — call-count-uniform like every collective here).
 
     Fault seam ``collectives.allreduce``; see ``_combine_with_seam`` for
     why transient-error retry happens here only single-process (SPMD

@@ -666,7 +666,7 @@ class TrainStep:
             return self._step(*args), None
         return compiled(*args), flops
 
-    def run(self, batches, steps=None, prefetch=None):
+    def run(self, batches, steps=None, prefetch=None, guard=None):
         """Drive the fused step over an iterator of ``(x, y)`` batches with
         device prefetch: a background thread keeps the next
         ``MXNET_PREFETCH_BUFFER`` batches in flight (non-blocking
@@ -674,6 +674,17 @@ class TrainStep:
         staging overlaps the previous step's compute.  ``prefetch``
         overrides the depth (0 = serial staging).  Returns the per-step
         losses (device scalars — only the last is synced).
+
+        ``guard`` (a :class:`mxnet_tpu.guard.Guard`, default = a fresh
+        one when ``MXNET_GUARD=1``) polls the loss sentinel after every
+        step: the fused jit commits its update before any verdict can
+        land (donated buffers), so an anomalous verdict cannot skip —
+        ``Guard.poll_loss`` escalates persistent anomalies straight to
+        ``GuardRewind``, which ``run_with_recovery`` absorbs as a
+        rewind-class restart from the latest valid checkpoint.  The
+        poll feeds on the step's lazily-dispatched loss scalar: with the
+        default sync stride it adds no trace and no extra collective
+        beyond the one agreement the verdict needs.
 
         With ``steps=N`` the loop never pops past batch N, but the
         background pipeline has up to ``depth`` more batches staged which
@@ -688,8 +699,12 @@ class TrainStep:
         ``lifecycle.stop_requested()``, publishes its final checkpoint,
         and raises ``lifecycle.GracefulExit``."""
         from .. import flight_recorder as _flight
+        from .. import guard as _guard_mod
         from .. import lifecycle as _lifecycle
         from ..gluon.data.prefetcher import PrefetchIterator
+
+        if guard is None and _guard_mod.enabled():
+            guard = _guard_mod.Guard()
 
         if prefetch is None:
             # resolve through the tuning funnel with THIS step's plan
@@ -719,6 +734,8 @@ class TrainStep:
                         break
                     x, y = batch[0], batch[1]
                     losses.append(self(x, y))
+                    if guard is not None:
+                        guard.poll_loss(losses[-1], step=len(losses))
             finally:
                 it.close()
             if losses:

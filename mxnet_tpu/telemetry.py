@@ -312,20 +312,22 @@ _STEPS_TOTAL = counter("mxnet_steps_total", "completed timeline steps")
 # minus any in-step checkpoint phase); the non-productive buckets are
 # noted by the lifecycle/recovery seams that own them — checkpoint
 # saves, run_with_recovery restart downtime, live resharding transfers,
-# watchdog-diagnosed stalls.  The ratio gauge is computed at export
-# time by a collector so recording stays one counter add.
+# watchdog-diagnosed stalls, and numerical-integrity rewinds (time lost
+# to wrong VALUES rather than lost processes; mxnet_tpu/guard.py).
+# The ratio gauge is computed at export time by a collector so
+# recording stays one counter add.
 _GOODPUT = counter(
     "mxnet_goodput_seconds_total",
     "wall time by goodput bucket (productive = step wall minus in-step "
-    "checkpoint time; checkpoint/restart/reshard/stall noted by their "
-    "owning seams)", labelnames=("bucket",))
+    "checkpoint time; checkpoint/restart/reshard/stall/rewind noted by "
+    "their owning seams)", labelnames=("bucket",))
 
 
 def goodput_note(bucket, seconds):
     """Charge ``seconds`` of wall time to a goodput ``bucket``
-    (``checkpoint`` / ``restart`` / ``reshard`` / ``stall`` / caller-
-    defined).  ``productive`` accrues automatically from the step
-    timeline — loops never call this themselves."""
+    (``checkpoint`` / ``restart`` / ``reshard`` / ``stall`` /
+    ``rewind`` / caller-defined).  ``productive`` accrues automatically
+    from the step timeline — loops never call this themselves."""
     if seconds > 0:
         _GOODPUT.labels(bucket=str(bucket)).inc(float(seconds))
 

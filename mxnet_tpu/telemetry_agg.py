@@ -327,6 +327,12 @@ def merge_blackboxes(boxes):
     seq across ranks must carry equal tags.  Verdicts, in priority
     order:
 
+    - ``numerical_divergence`` — the guard's quarantine evidence
+      (``guard_checksum`` post-allreduce bucket digests /
+      ``guard_canary`` recompute digests, bit-identical across ranks by
+      construction) disagrees at a (step, key): silent data corruption
+      or desync ON the named minority rank(s).  Checked first —
+      explicit recorded evidence beats the inferred verdicts below.
     - ``desync`` — the first sequence number where ranks' tags diverge
       (a rank issued a different/extra collective); blamed ranks are
       the minority tag holders at that seq.
@@ -394,6 +400,57 @@ def merge_blackboxes(boxes):
 def _blame(ranks, ledgers, per_rank, boxes):
     if not ranks:
         return _verdict("no_data", "no black-box files to merge")
+    # -- numerical divergence: guard checksum/canary digests disagree --
+    # The quarantine tier (mxnet_tpu/guard.py) stamps digests of data
+    # that is bit-identical across ranks by construction; a mismatch at
+    # the same (step, key) is positive evidence of SDC/desync on the
+    # minority rank — stronger than anything inferred from ledger
+    # positions, so it is checked before every other verdict.
+    if len(ranks) > 1:
+        stamped: dict = {}
+        for r in ranks:
+            for e in boxes[r].get("events") or ():
+                if not isinstance(e, dict):
+                    continue
+                if e.get("kind") == "guard_checksum":
+                    k = (e.get("step"), str(e.get("key")))
+                    d = e.get("crc")
+                elif e.get("kind") == "guard_canary":
+                    k = (e.get("step"), "__canary__")
+                    d = e.get("digest")
+                else:
+                    continue
+                stamped.setdefault(k, {})[r] = (d, e.get("seq"))
+        for k in sorted(stamped, key=lambda kk: (kk[0] is None,
+                                                 kk[0] or 0, kk[1])):
+            per = stamped[k]
+            if len(per) < 2:
+                continue
+            vals = {r: v[0] for r, v in per.items()}
+            if len(set(vals.values())) <= 1:
+                continue
+            counts: dict = {}
+            for d in vals.values():
+                counts[d] = counts.get(d, 0) + 1
+            majority = max(sorted(counts, key=repr),
+                           key=lambda d: counts[d])
+            blamed = sorted(r for r, d in vals.items() if d != majority)
+            if len(set(counts.values())) == 1 and len(counts) > 1:
+                blamed = sorted(vals)       # tie: every holder suspect
+            step, key = k
+            b0 = blamed[0]
+            v = _verdict(
+                "numerical_divergence",
+                f"guard digest for {key!r} at step {step} diverges: " +
+                ", ".join(f"rank {r}={vals[r]!r}"
+                          for r in sorted(vals)) +
+                " — the stamped payload is bit-identical across ranks "
+                "by construction, so the minority rank(s) hold "
+                "corrupted values (SDC or silent desync)",
+                ranks=blamed, seq=per[b0][1], tag=key,
+                digest=vals[b0])
+            v["step"] = step
+            return v
     # -- desync: first seq where tags diverge across any two ranks -----
     if len(ranks) > 1:
         shared = set()

@@ -85,14 +85,14 @@ def test_pass_catalog_complete():
                            "serving-hot-path", "planner-sharding",
                            "graph-pass-contracts", "resharding-transfer",
                            "metric-registry", "ledger-discipline",
-                           "fleet-discipline"}
+                           "fleet-discipline", "guard-discipline"}
     all_codes = {c for cls in passes.values() for c in cls.codes}
     assert all_codes == {"MXT001", "MXT002", "MXT003", "MXT005",
                          "MXT006", "MXT010", "MXT020", "MXT021",
                          "MXT022", "MXT030", "MXT031", "MXT032",
                          "MXT040", "MXT050", "MXT060", "MXT070",
                          "MXT071", "MXT080", "MXT090", "MXT091",
-                         "MXT100", "MXT110"}
+                         "MXT100", "MXT110", "MXT120", "MXT121"}
 
 
 def test_parse_error_reported_not_fatal(tmp_path):
@@ -843,6 +843,80 @@ def test_mxt110_noqa_waiver(tmp_path):
             return conn
         """)
     assert codes_at(check(tmp_path), "MXT110") == []
+
+
+# -- MXT120-121 guard discipline ---------------------------------------------
+def test_mxt120_mutation_bypasses_verdict_gate(tmp_path):
+    """A seeded scope (verdict assigned from guard.check) that calls a
+    mutator without consulting the verdict is flagged; the compliant
+    twin gating on the verdict (directly or via the one-level
+    Guard.action derivation) stays silent."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/loop.py", """
+        from .guard import Guard
+
+        def bad_loop(trainer, params):
+            g = Guard()
+            verdict = g.check(params)
+            trainer.step(32)                       # line 6: ungated
+
+        def good_loop(trainer, params):
+            g = Guard()
+            verdict = g.check(params)
+            if verdict == "ok":
+                trainer.step(32)
+
+        def good_derived(trainer, params):
+            g = Guard()
+            verdict = g.check(params)
+            act = g.action(verdict)
+            if act == "commit":
+                trainer.step(32)
+
+        def unseeded(trainer):
+            trainer.step(32)  # no verdict in scope: out of scope
+        """)
+    hits = codes_at(check(tmp_path), "MXT120")
+    assert hits == [("mxnet_tpu/loop.py", 6)], hits
+
+
+def test_mxt121_rank_conditional_verdict_check(tmp_path):
+    """Guard.check under a rank-conditional branch breaks the
+    equal-call-count contract of the verdict agreement collective; the
+    unconditional twin stays silent."""
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/rankcheck.py", """
+        import jax
+        from .guard import Guard
+
+        def bad(params):
+            g = Guard()
+            if jax.process_index() == 0:
+                v = g.check(params)                # line 7
+
+        def good(params):
+            g = Guard()
+            v = g.check(params)
+            if v == "ok":
+                return True
+            return False
+        """)
+    hits = codes_at(check(tmp_path), "MXT121")
+    assert hits == [("mxnet_tpu/rankcheck.py", 7)], hits
+
+
+def test_mxt120_noqa_waiver(tmp_path):
+    mini_repo(tmp_path)
+    put(tmp_path, "mxnet_tpu/waived.py", """
+        from .guard import Guard
+
+        def observe_only(trainer, params):
+            g = Guard()
+            verdict = g.check(params)
+            # mxtpu: noqa[MXT120] observation mode: verdict is exported
+            trainer.step(32)
+        """)
+    assert codes_at(check(tmp_path), "MXT120") == []
 
 
 # -- MXT020-022 lock/thread hygiene -----------------------------------------

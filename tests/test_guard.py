@@ -1,0 +1,624 @@
+"""Numerical-integrity guard (mxnet_tpu/guard.py — ISSUE 20): the fused
+SDC sentinel + verdict classification, the skip/rewind remediation
+ladder, AMP unification (one host sync per guarded step), quarantine
+checksums + canary voting, the ``numerical_divergence`` blame verdict,
+and the guard's fault seams."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, fault, flight_recorder, gluon, nd
+from mxnet_tpu import guard as guard_mod
+from mxnet_tpu import lifecycle, telemetry, telemetry_agg
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import CheckpointManager, run_with_recovery
+from mxnet_tpu.contrib import amp
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXNET_GUARD", "MXNET_GUARD_CHECKSUM", "MXNET_FAULT_SPEC",
+                "MXNET_FLIGHT_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.reset()
+    flight_recorder.reset()
+    fault.reload_spec()
+    fault.reset_stats()
+    yield
+    amp.disable()
+    telemetry.reset()
+    flight_recorder.reset()
+    fault.reload_spec()
+    fault.reset_stats()
+
+
+def _net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=4, activation="relu"),
+            gluon.nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _data(seed=0):
+    R = np.random.RandomState(seed)
+    X = R.randn(16, 4).astype("f")
+    Y = (X.sum(1) > 0).astype("f")
+    return X, Y
+
+
+def _backward(net, X, Y):
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = lf(net(nd.array(X)), nd.array(Y))
+    loss.backward()
+    return loss
+
+
+def _params(net):
+    return list(net.collect_params().values())
+
+
+def _poison(net, factor=np.inf):
+    p = _params(net)[0]
+    g = p.grad()
+    g._set(g._get() * factor)
+
+
+def _counter(name):
+    fam = telemetry.snapshot()["metrics"].get(name)
+    if not fam or not fam["samples"]:
+        return 0
+    return sum(s["value"] for s in fam["samples"])
+
+
+# --------------------------------------------------------------------------
+# fused sentinel reductions
+# --------------------------------------------------------------------------
+def test_nonfinite_total_counts_poisoned_grads():
+    net = _net()
+    X, Y = _data()
+    _backward(net, X, Y)
+    total = guard_mod.nonfinite_total(_params(net))
+    assert float(np.asarray(total)) == 0.0
+    _poison(net)
+    total = guard_mod.nonfinite_total(_params(net))
+    assert float(np.asarray(total)) > 0
+
+
+def test_integrity_stats_vector_channels():
+    net = _net()
+    X, Y = _data()
+    _backward(net, X, Y)
+    vec = np.asarray(guard_mod.integrity_stats(_params(net), loss=2.5))
+    assert vec.shape == (4,)
+    nf, gsq, loss, present = (float(v) for v in vec)
+    assert nf == 0.0 and gsq > 0.0
+    assert loss == pytest.approx(2.5) and present == 1.0
+    # loss channel absent without a staged loss
+    vec = np.asarray(guard_mod.integrity_stats(_params(net)))
+    assert float(vec[3]) == 0.0
+
+
+def test_loss_scaler_overflow_parity_with_guard_sentinel():
+    """Satellite (b): AMP's ``has_overflow`` and the guard's non-finite
+    channel share ONE reduction source, so their verdicts can never
+    disagree — clean and poisoned."""
+    net = _net()
+    X, Y = _data()
+    _backward(net, X, Y)
+    scaler = amp.LossScaler()
+    gd = guard_mod.Guard(window=16)
+    assert scaler.has_overflow(_params(net)) is False
+    gd.check(params=_params(net))
+    assert gd.last_stats["nonfinite"] == 0
+    _poison(net)
+    assert scaler.has_overflow(_params(net)) is True
+    gd2 = guard_mod.Guard(window=16)
+    gd2.check(params=_params(net))
+    assert gd2.last_stats["nonfinite"] > 0
+
+
+# --------------------------------------------------------------------------
+# verdict classification
+# --------------------------------------------------------------------------
+def test_verdict_ok_then_nonfinite():
+    net = _net()
+    X, Y = _data()
+    _backward(net, X, Y)
+    gd = guard_mod.Guard(window=16)
+    assert gd.check(params=_params(net), loss=1.0) == "ok"
+    _poison(net)
+    assert gd.check(params=_params(net), loss=1.0) == "nonfinite"
+    assert _counter("mxnet_guard_verdicts_total") == 1
+
+
+def test_verdict_nan_loss_is_nonfinite():
+    gd = guard_mod.Guard(window=16)
+    assert gd.check(loss=float("nan")) == "nonfinite"
+
+
+def test_verdict_loss_spike_against_robust_window():
+    gd = guard_mod.Guard(window=16, loss_spike=5.0)
+    for i in range(guard_mod.MIN_HISTORY):
+        assert gd.check(loss=1.0 + 0.01 * i) == "ok"
+    assert gd.check(loss=50.0) == "loss_spike"
+
+
+def test_verdict_grad_anomaly_against_robust_window():
+    net = _net()
+    X, Y = _data()
+    _backward(net, X, Y)
+    gd = guard_mod.Guard(window=16, grad_spike=5.0)
+    for _ in range(guard_mod.MIN_HISTORY):
+        assert gd.check(params=_params(net)) == "ok"
+    _poison(net, factor=1e6)    # huge but finite
+    assert gd.check(params=_params(net)) == "grad_anomaly"
+
+
+def test_spike_needs_min_history():
+    gd = guard_mod.Guard(window=16, loss_spike=5.0)
+    for _ in range(guard_mod.MIN_HISTORY - 1):
+        gd.check(loss=1.0)
+    # below MIN_HISTORY the robust window stays silent — only hard
+    # non-finite evidence trips
+    assert gd.check(loss=1e9) == "ok"
+
+
+def test_anomalies_never_feed_the_baseline():
+    gd = guard_mod.Guard(window=16, loss_spike=5.0)
+    for _ in range(guard_mod.MIN_HISTORY):
+        gd.check(loss=1.0)
+    before = list(gd._losses)
+    assert gd.check(loss=77.0) == "loss_spike"
+    assert list(gd._losses) == before   # the spike cannot poison it
+    assert gd.check(loss=1.0) == "ok"
+
+
+def test_sync_every_stride_returns_last_agreed():
+    """check_stop's amortization shape: off-cycle calls issue no sync
+    and return the last AGREED verdict — anomaly latency grows to at
+    most N steps, call counts stay uniform by construction."""
+    gd = guard_mod.Guard(window=16, sync_every=3)
+    assert gd.check(loss=float("nan")) == "ok"   # off-cycle (call 1)
+    assert gd.check(loss=float("nan")) == "ok"   # off-cycle (call 2)
+    assert gd.check(loss=float("nan")) == "nonfinite"  # synced (call 3)
+    assert _counter("mxnet_guard_checks_total") == 3
+
+
+def test_check_through_real_combine_path():
+    """_testing_force exercises the actual allreduce_hosts agreement on
+    one process (the collectives testing convention)."""
+    net = _net()
+    X, Y = _data()
+    _backward(net, X, Y)
+    _poison(net)
+    gd = guard_mod.Guard(window=16, _testing_force=True)
+    assert gd.check(params=_params(net), loss=1.5) == "nonfinite"
+    # the summed loss channel still recovers the mean
+    assert gd.last_stats["loss"] == pytest.approx(1.5)
+
+
+# --------------------------------------------------------------------------
+# remediation ladder: action / skip / rewind
+# --------------------------------------------------------------------------
+def test_action_ladder_knobs():
+    gd = guard_mod.Guard(window=16, skip=True, rewind_after=0)
+    assert gd.action("ok") == "commit"
+    assert gd.action("nonfinite") == "skip"
+    observe = guard_mod.Guard(window=16, skip=False, rewind_after=0)
+    assert observe.action("loss_spike") == "commit"   # verdict-only mode
+    # rewind tier without a bound manager degrades to skip (warned once)
+    esc = guard_mod.Guard(window=16, skip=True, rewind_after=1)
+    esc._recent.append(1)
+    assert esc.action("grad_anomaly") == "skip"
+
+
+def test_attach_skips_anomalous_step():
+    net = _net()
+    X, Y = _data()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    guard_mod.attach(trainer, guard=guard_mod.Guard(window=16))
+    _backward(net, X, Y)
+    _poison(net)
+    name0 = list(net.collect_params().keys())[0]
+    before = net.collect_params()[name0].data().asnumpy().copy()
+    trainer.step(16)
+    after = net.collect_params()[name0].data().asnumpy()
+    assert np.allclose(before, after), "anomalous update must be zeroed"
+    assert _counter("mxnet_guard_skips_total") == 1
+    # a clean step still commits
+    _backward(net, X, Y)
+    trainer.step(16)
+    assert not np.allclose(
+        before, net.collect_params()[name0].data().asnumpy())
+
+
+def test_guard_on_clean_run_is_bit_identical():
+    """Acceptance: guard-on trajectories equal guard-off trajectories
+    exactly on clean runs — the gate adds no numerics."""
+    X, Y = _data(3)
+    weights = {}
+    for guarded in (False, True):
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = _net()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        if guarded:
+            guard_mod.attach(trainer, guard=guard_mod.Guard(window=16))
+        for _ in range(4):
+            _backward(net, X, Y)
+            trainer.step(16)
+        weights[guarded] = [p.data().asnumpy().copy()
+                            for p in net.collect_params().values()]
+    for off, on in zip(weights[False], weights[True]):
+        np.testing.assert_array_equal(off, on)
+
+
+def test_attach_amp_unified_gate_skips_and_halves_scale():
+    """Satellite (b): a guard-attached AMP trainer routes the overflow
+    skip through the guard verdict — same semantics as the standalone
+    AMP wrapper (skip + halve), one fused sync."""
+    net = _net()
+    X, Y = _data()
+    amp.init("float16")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer, loss_scaler=amp.LossScaler(init_scale=64.0))
+    guard_mod.attach(trainer, guard=guard_mod.Guard(window=16))
+    scaler = trainer._amp_loss_scaler
+    _backward(net, X, Y)
+    _poison(net)
+    name0 = list(net.collect_params().keys())[0]
+    before = net.collect_params()[name0].data().asnumpy().copy()
+    trainer.step(16)
+    after = net.collect_params()[name0].data().asnumpy()
+    assert np.allclose(before, after), "overflow step must be skipped"
+    assert scaler.loss_scale == 32.0
+    assert _counter("mxnet_guard_skips_total") == 1
+    # clean step commits and the scale holds
+    _backward(net, X, Y)
+    trainer.step(16)
+    assert scaler.loss_scale == 32.0
+    assert np.isfinite(
+        net.collect_params()[name0].data().asnumpy()).all()
+
+
+def test_amp_after_attach_is_rejected():
+    net = _net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    guard_mod.attach(trainer, guard=guard_mod.Guard(window=16))
+    amp.init("float16")
+    with pytest.raises(MXNetError, match="attach order"):
+        amp.init_trainer(trainer)
+
+
+def test_rewind_restores_latest_valid_checkpoint(tmp_path):
+    X, Y = _data(1)
+    net = _net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    for _ in range(2):
+        _backward(net, X, Y)
+        trainer.step(16)
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(2, net, trainer,
+             train_state=lifecycle.capture_train_state(step=2))
+    want = net(nd.array(X)).asnumpy().copy()
+    for _ in range(2):      # drift past the checkpoint
+        _backward(net, X, Y)
+        trainer.step(16)
+    assert not np.allclose(net(nd.array(X)).asnumpy(), want)
+    gd = guard_mod.Guard(window=16, rewind_after=1)
+    gd.bind_rewind(mgr, net=net, trainer=trainer)
+    assert gd.rewind() == 2
+    np.testing.assert_allclose(net(nd.array(X)).asnumpy(), want,
+                               rtol=1e-6)
+    assert _counter("mxnet_guard_rewinds_total") == 1
+    assert telemetry.goodput_summary()["buckets"].get("rewind", 0) > 0
+
+
+def test_attach_ladder_escalates_to_rewind(tmp_path):
+    """Repeated anomalies inside the window trip the rewind tier: the
+    guarded step restores the checkpoint in place, on the SAME call on
+    every rank (the verdict and window state are mesh-agreed)."""
+    X, Y = _data(2)
+    net = _net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    _backward(net, X, Y)
+    trainer.step(16)
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(1, net, trainer)
+    want = net(nd.array(X)).asnumpy().copy()
+    guard_mod.attach(trainer, guard=guard_mod.Guard(window=16,
+                                                    rewind_after=2),
+                     manager=mgr, net=net)
+    for _ in range(2):
+        _backward(net, X, Y)
+        _poison(net)
+        trainer.step(16)    # skip, then rewind
+    assert _counter("mxnet_guard_rewinds_total") == 1
+    np.testing.assert_allclose(net(nd.array(X)).asnumpy(), want,
+                               rtol=1e-6)
+
+
+def test_rewind_with_no_valid_checkpoint_falls_back(tmp_path):
+    gd = guard_mod.Guard(window=16, rewind_after=1)
+    gd.bind_rewind(CheckpointManager(str(tmp_path / "empty")))
+    assert gd.rewind() is None
+    assert _counter("mxnet_guard_rewinds_total") == 0
+
+
+def test_poll_loss_escalates_to_guard_rewind_on_fused_path():
+    gd = guard_mod.Guard(window=16, rewind_after=2)
+    assert gd.poll_loss(1.0, step=1) == "ok"
+    assert gd.poll_loss(float("nan"), step=2) == "nonfinite"  # skip 1
+    with pytest.raises(guard_mod.GuardRewind, match="rewind"):
+        gd.poll_loss(float("nan"), step=3)
+
+
+def test_trainstep_run_polls_the_loss_sentinel():
+    from mxnet_tpu.parallel.data_parallel import TrainStep
+
+    def loss_fn(logits, labels):
+        import jax
+        import jax.numpy as jnp
+
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=-1)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=4), gluon.nn.Dense(2, in_units=8))
+    net.initialize()
+    X = np.random.uniform(-1, 1, (8, 4)).astype("float32")
+    Y = np.random.randint(0, 2, (8,)).astype("int32")
+    net(nd.array(X))
+    step = TrainStep(net, loss_fn, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.05})
+    losses = step.run([(X, Y)] * 3, guard=guard_mod.Guard(window=16))
+    assert len(losses) == 3
+    assert _counter("mxnet_guard_checks_total") == 3
+
+
+def test_guard_off_is_a_noop():
+    assert guard_mod.enabled() is False
+    assert guard_mod.checksum_enabled() is False
+    net = _net()
+    X, Y = _data()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    _backward(net, X, Y)
+    trainer.step(16)
+    assert _counter("mxnet_guard_checks_total") == 0
+
+
+def test_run_with_recovery_charges_rewind_bucket(tmp_path):
+    """Satellite (c): a guard-verdict failure's downtime lands in the
+    ``rewind`` goodput bucket, not ``restart``."""
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    attempts = []
+
+    def train(start, manager):
+        attempts.append(start)
+        if len(attempts) == 1:
+            raise guard_mod.GuardRewind("persistent loss_spike")
+        return "done", None
+
+    status, _ = run_with_recovery(train, mgr, max_restarts=2,
+                                  backoff_ms=0)
+    assert status == "done" and len(attempts) == 2
+    buckets = telemetry.goodput_summary()["buckets"]
+    assert buckets.get("rewind", 0) > 0
+    assert buckets.get("restart", 0) == 0
+
+
+def test_divergence_dumps_blackbox_with_reason(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_FLIGHT_DIR", str(tmp_path))
+    flight_recorder.configure(capacity=32, rank=0)
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    attempts = []
+
+    def train(start, manager):
+        attempts.append(start)
+        if len(attempts) == 1:
+            raise guard_mod.NumericalDivergence("canary vote", ranks=(1,))
+        return "done", None
+
+    run_with_recovery(train, mgr, max_restarts=2, backoff_ms=0)
+    doc = json.loads((tmp_path / "blackbox.rank0.json").read_text())
+    assert doc["reason"] == "numerical_divergence"
+    assert telemetry.goodput_summary()["buckets"].get("rewind", 0) > 0
+
+
+# --------------------------------------------------------------------------
+# quarantine: checksum stamps, canary vote, blame merge
+# --------------------------------------------------------------------------
+def test_stamp_bucket_checksum_is_deterministic():
+    flight_recorder.configure(capacity=32, rank=0)
+    payload = np.arange(8, dtype="f")
+    guard_mod.stamp_bucket_checksum("__grad_bucket0g1", payload, step=5)
+    guard_mod.stamp_bucket_checksum("__grad_bucket0g1", payload, step=6)
+    events = [e for e in flight_recorder.snapshot_doc()["events"]
+              if e.get("kind") == "guard_checksum"]
+    assert len(events) == 2
+    assert events[0]["key"] == "__grad_bucket0g1"
+    assert events[0]["step"] == 5 and events[1]["step"] == 6
+    # identical payload -> identical digest (the property blame rides on)
+    assert events[0]["crc"] == events[1]["crc"]
+    assert _counter("mxnet_guard_bucket_checksums_total") == 2
+
+
+def test_bucketed_allreduce_stamps_checksums(monkeypatch):
+    """The fused-allreduce path stamps quarantine evidence when
+    MXNET_GUARD_CHECKSUM=1 — independent of the master gate."""
+    monkeypatch.setenv("MXNET_GUARD_CHECKSUM", "1")
+    monkeypatch.setenv("MXNET_ALLREDUCE_BUCKET_MB", "32")
+    flight_recorder.configure(capacity=64, rank=0)
+    net = _net()
+    X, Y = _data()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    _backward(net, X, Y)
+    trainer.step(16)
+    events = [e for e in flight_recorder.snapshot_doc()["events"]
+              if e.get("kind") == "guard_checksum"]
+    assert events, "fused bucket must stamp its post-allreduce digest"
+    assert events[0]["key"].startswith("__grad_bucket")
+    assert _counter("mxnet_guard_bucket_checksums_total") >= 1
+
+
+def test_canary_digest_deterministic_and_agreeing():
+    flight_recorder.configure(capacity=32, rank=0)
+    gd = guard_mod.Guard(window=16)
+    fn = lambda: np.arange(16, dtype="f") * 0.5  # noqa: E731
+    d1 = gd.canary(fn, step=1)
+    d2 = gd.canary(fn, step=2)
+    assert d1 == d2 and 0 <= d1 <= 0xFFFFFF
+    assert _counter("mxnet_guard_canary_votes_total") == 2
+    events = [e for e in flight_recorder.snapshot_doc()["events"]
+              if e.get("kind") == "guard_canary"]
+    assert [e["digest"] for e in events] == [d1, d1]
+
+
+def test_canary_minority_digest_raises_uniformly(monkeypatch):
+    """A minority digest in the gathered table raises
+    NumericalDivergence naming the minority rank — on EVERY rank, since
+    all classify the same agreed table."""
+    from mxnet_tpu.parallel import collectives
+
+    monkeypatch.setattr(
+        collectives, "allreduce_hosts",
+        lambda value, _testing_force=False: np.array([7.0, 9.0, 7.0],
+                                                     "f"))
+    gd = guard_mod.Guard(window=16, _testing_force=True)
+    with pytest.raises(guard_mod.NumericalDivergence) as ei:
+        gd.canary(lambda: np.ones(4, dtype="f"), step=12)
+    assert ei.value.ranks == (1,)
+    assert "minority" in str(ei.value)
+
+
+def _guard_box(rank, events, world=3):
+    return {"format": 1, "rank": rank, "world": world,
+            "position": len(events), "events": events,
+            "reason": "numerical_divergence", "time": 100.0 + rank}
+
+
+def _crc_event(crc, step=184, seq=7, key="__grad_bucket0g1"):
+    return {"kind": "guard_checksum", "key": key, "crc": crc,
+            "seq": seq, "step": step}
+
+
+def test_blame_numerical_divergence_names_minority_rank():
+    boxes = {0: _guard_box(0, [_crc_event(111)]),
+             1: _guard_box(1, [_crc_event(111)]),
+             2: _guard_box(2, [_crc_event(222)])}
+    v = telemetry_agg.merge_blackboxes(boxes)["verdict"]
+    assert v["kind"] == "numerical_divergence"
+    assert v["ranks"] == [2]
+    assert v["step"] == 184 and v["tag"] == "__grad_bucket0g1"
+    assert v["seq"] == 7
+    assert "SDC" in v["detail"] or "corrupted" in v["detail"]
+
+
+def test_blame_canary_digests_and_agreement_cases():
+    def canary_ev(digest, step=9):
+        return {"kind": "guard_canary", "step": step, "digest": digest,
+                "seq": 3}
+
+    boxes = {0: _guard_box(0, [canary_ev(5)]),
+             1: _guard_box(1, [canary_ev(6)]),
+             2: _guard_box(2, [canary_ev(5)])}
+    v = telemetry_agg.merge_blackboxes(boxes)["verdict"]
+    assert v["kind"] == "numerical_divergence" and v["ranks"] == [1]
+    # agreeing digests are NOT divergence — falls through to no_blame
+    agree = {r: _guard_box(r, [_crc_event(42)]) for r in (0, 1, 2)}
+    v = telemetry_agg.merge_blackboxes(agree)["verdict"]
+    assert v["kind"] == "no_blame"
+    # a 1-1 tie blames every holder (no majority to trust)
+    tie = {0: _guard_box(0, [_crc_event(1)], world=2),
+           1: _guard_box(1, [_crc_event(2)], world=2)}
+    v = telemetry_agg.merge_blackboxes(tie)["verdict"]
+    assert v["kind"] == "numerical_divergence" and v["ranks"] == [0, 1]
+
+
+def test_teldump_blame_surfaces_numerical_divergence(tmp_path):
+    """Satellite (c): the offline ``teldump blame`` re-merge prints the
+    verdict, the minority rank, and the step."""
+    for r, crc in ((0, 111), (1, 111), (2, 222)):
+        with open(str(tmp_path / f"blackbox.rank{r}.json"), "w") as f:
+            json.dump(_guard_box(r, [_crc_event(crc)]), f)
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.teldump", "blame", str(tmp_path)],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    assert "NUMERICAL_DIVERGENCE" in r.stdout
+    assert "step   184" in r.stdout
+    assert "[2]" in r.stdout
+
+
+# --------------------------------------------------------------------------
+# exact-resume state
+# --------------------------------------------------------------------------
+def test_state_dict_roundtrip_preserves_classification():
+    gd = guard_mod.Guard(window=16, loss_spike=5.0)
+    for i in range(guard_mod.MIN_HISTORY):
+        gd.check(loss=1.0 + 0.01 * i)
+    st = gd.state_dict()
+    assert json.loads(json.dumps(st)) == st     # JSON-able by contract
+    fresh = guard_mod.Guard(window=16, loss_spike=5.0)
+    fresh.load_state_dict(st)
+    # the resumed guard classifies the next step exactly as the
+    # original would have: spike trips, clean passes
+    assert fresh.check(loss=50.0) == "loss_spike"
+    assert gd.check(loss=50.0) == "loss_spike"
+
+
+def test_capture_train_state_carries_the_guard():
+    gd = guard_mod.Guard(window=16)
+    gd.check(loss=1.25)
+    st = lifecycle.capture_train_state(step=7, guard=gd)
+    assert st["guard"]["losses"] == [1.25]
+    g2 = guard_mod.Guard(window=16)
+    lifecycle.restore_train_state(st, guard=g2)
+    assert g2.state_dict() == gd.state_dict()
+
+
+# --------------------------------------------------------------------------
+# fault seams (satellite a: one chaos test per seam)
+# --------------------------------------------------------------------------
+def test_chaos_guard_check_seam():
+    gd = guard_mod.Guard(window=16)
+    with fault.inject("guard.check", error=RuntimeError, times=1):
+        with pytest.raises(RuntimeError):
+            gd.check(loss=1.0)
+        assert gd.check(loss=1.0) == "ok"   # disarmed after one trip
+    assert fault.stats()["guard.check"]["trips"] == 1
+
+
+def test_chaos_guard_rewind_seam(tmp_path):
+    gd = guard_mod.Guard(window=16, rewind_after=1)
+    gd.bind_rewind(CheckpointManager(str(tmp_path / "c")))
+    with fault.inject("guard.rewind", error=OSError, times=1):
+        with pytest.raises(OSError):
+            gd.rewind()
+    assert fault.stats()["guard.rewind"]["trips"] == 1
+
+
+def test_chaos_guard_canary_seam():
+    gd = guard_mod.Guard(window=16)
+    with fault.inject("guard.canary", error=RuntimeError, times=1):
+        with pytest.raises(RuntimeError):
+            gd.canary(lambda: np.ones(4, dtype="f"), step=1)
+    assert fault.stats()["guard.canary"]["trips"] == 1

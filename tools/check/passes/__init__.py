@@ -12,13 +12,16 @@ MXT08x live-resharding transfer discipline (plans executed or
 explicitly discarded, at uniform SPMD level), MXT09x metric-catalog
 closure, MXT10x flight-recorder ledger discipline, MXT11x fleet
 dispatch discipline (one funnel, always a deadline, no jax in the
-router plane).
+router plane), MXT12x numerical-integrity guard discipline (verdict
+collectives call-count-uniform, no mutation bypassing the verdict
+gate).
 """
 from . import collectives  # noqa: F401
 from . import envknobs  # noqa: F401
 from . import faultseams  # noqa: F401
 from . import fleetdiscipline  # noqa: F401
 from . import graphpass  # noqa: F401
+from . import guarddiscipline  # noqa: F401
 from . import hotpath  # noqa: F401
 from . import ledger  # noqa: F401
 from . import metrics  # noqa: F401

@@ -1,0 +1,251 @@
+"""MXT120-121: numerical-integrity guard discipline.
+
+ISSUE 20's guard (:mod:`mxnet_tpu.guard`) only works if two structural
+invariants hold at every adoption site:
+
+- **MXT120 — mutation bypassing the verdict gate.**  In a *guarded
+  scope* (a function that assigns a verdict from ``<guard>.check(...)``),
+  every optimizer/parameter mutation (``step`` / ``_update`` /
+  ``step_bucket`` / ``updater`` / ``apply_gradients`` and friends) must
+  be conditioned — directly or through one level of derivation
+  (``act = g.action(verdict)``) — on that verdict.  An unconditional
+  mutation next to a computed verdict means the guard observes but no
+  longer protects: the anomalous update commits anyway, which is
+  exactly the silent failure the skip tier exists to stop.
+- **MXT121 — rank-conditional verdict collective.**  ``Guard.check``
+  issues the verdict-agreement collective (one ``allreduce_hosts`` of
+  the sentinel vector), so calling it under a rank-conditional branch
+  (``process_index()``, ``rank``-tainted locals, worker-id env reads)
+  breaks the equal-call-count contract the agreement rides on: some
+  peers issue the collective, others never do, and the mesh hangs —
+  the MXT001 failure mode, surfaced at the guard's own seam.  Stride
+  amortization belongs INSIDE ``check`` (``MXNET_GUARD_SYNC_EVERY``,
+  call-count-deterministic), never at the call site.
+
+Scope: only functions that actually seed a verdict are analyzed
+(MXT120) — the pass adds no noise to the 99% of the repo that never
+touches the guard.  Guard receivers are names assigned from
+``Guard(...)`` / ``attach(...)`` expressions, or any name/attribute
+spelled ``guard``-ish (``g._guard``, ``trainer._guard``, parameter
+``guard``).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, names_in
+from ..core import Finding, Pass, register
+from .pairing import _outermost_functions
+
+# verdict-producing guard methods (the collective + sync live here)
+_CHECK_METHODS = {"check", "poll_loss"}
+# optimizer/parameter mutators that must sit behind the verdict gate
+_MUTATORS = {"step", "plain_step", "orig_step", "amp_step", "_update",
+             "update", "step_bucket", "_zero_step_bucket", "updater",
+             "apply_gradients"}
+# rank-conditional vocabulary (the MXT001 classifier's, minus the
+# uniform markers — a process_count() guard is fine)
+_RANK_MARKERS = {"process_index", "worker_id", "launcher_rank",
+                 "_launcher_rank", "rank", "primary", "_primary",
+                 "is_primary", "mxnet_worker_id", "dmlc_worker_id"}
+
+
+def _guardish(name):
+    """A dotted name that denotes a guard by spelling (``guard``,
+    ``self._guard``, ``trainer._guard``...)."""
+    return name is not None and "guard" in name.rsplit(".", 1)[-1].lower()
+
+
+def _receivers(fn):
+    """Names bound to a guard inside ``fn``: guard-ish parameters plus
+    assignment targets whose value mentions ``Guard(...)``/``attach``
+    or an already guard-ish name."""
+    recv = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        if _guardish(a.arg):
+            recv.add(a.arg)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        src = node.value
+        hit = any(isinstance(sub, ast.Call) and
+                  (call_name(sub) or "").rsplit(".", 1)[-1]
+                  in {"Guard", "attach"}
+                  for sub in ast.walk(src))
+        if not hit:
+            hit = any(_guardish(n) for n in names_in(src))
+        if hit:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    recv.add(tgt.id)
+    return recv
+
+
+def _is_check_call(call, recv):
+    """``<receiver>.check(...)`` / ``<receiver>.poll_loss(...)`` where
+    the receiver is a known guard name or guard-ish attribute chain."""
+    name = call_name(call)
+    if name is None or "." not in name:
+        return False
+    head, _, tail = name.rpartition(".")
+    if tail not in _CHECK_METHODS:
+        return False
+    base = head.split(".", 1)[0]
+    return base in recv or _guardish(head)
+
+
+def _tainted_names(fn, recv):
+    """The verdict taint set: assignment targets of guard check calls,
+    closed one derivation level (``act = g.action(verdict)``)."""
+    tainted = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _is_check_call(node.value, recv):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    tainted.add(tgt.id)
+    if not tainted:
+        return tainted
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if {n for n in names_in(node.value)} & \
+                    {t.lower() for t in tainted}:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id not in tainted:
+                        tainted.add(tgt.id)
+                        changed = True
+    return tainted
+
+
+@register
+class GuardDiscipline(Pass):
+    name = "guard-discipline"
+    codes = {
+        "MXT120": "optimizer/param mutation bypasses the guard verdict "
+                  "gate",
+        "MXT121": "guard verdict collective under a rank-conditional "
+                  "branch",
+    }
+
+    def run(self, ctx, mod):
+        findings = []
+        # outermost functions only: a closure (guard.attach's guarded
+        # step) analyzes WITH its parent, which holds the receiver
+        # bindings and the taint
+        for fn in _outermost_functions(mod.tree):
+            recv = _receivers(fn)
+            # MXT121 needs no seed: ANY guard check call under a rank
+            # branch is a hang, assigned or not
+            self._scan_rank(fn, recv, mod, findings)
+            tainted = _tainted_names(fn, recv)
+            if not tainted:
+                continue
+            lowered = {t.lower() for t in tainted}
+            self._scan_gate(fn, fn.body, recv, lowered, False, mod,
+                            findings)
+        return findings
+
+    # -- MXT121: rank-conditional verdict collectives -------------------
+    def _scan_rank(self, fn, recv, mod, findings):
+        def walk(stmts, rank_depth):
+            for stmt in stmts:
+                local = rank_depth
+                if isinstance(stmt, (ast.If, ast.While)) and \
+                        names_in(stmt.test) & _RANK_MARKERS:
+                    local = rank_depth + 1
+                for expr in self._own_exprs(stmt):
+                    for sub in ast.walk(expr):
+                        if isinstance(sub, ast.Call) and \
+                                _is_check_call(sub, recv) and \
+                                rank_depth > 0:
+                            findings.append(Finding(
+                                code="MXT121", path=mod.relpath,
+                                line=sub.lineno,
+                                message="guard verdict check issued "
+                                        "under a rank-conditional branch "
+                                        "— the agreement collective "
+                                        "inside it desyncs SPMD call "
+                                        "counts",
+                                hint="call Guard.check unconditionally "
+                                     "at the step boundary on every "
+                                     "rank; amortize with "
+                                     "MXNET_GUARD_SYNC_EVERY instead of "
+                                     "a rank branch",
+                                scope=mod.qualname(sub),
+                                key=f"rank-check:{call_name(sub)}",
+                                col=sub.col_offset))
+                for field in ("body", "orelse", "finalbody"):
+                    inner = getattr(stmt, field, None)
+                    if inner and isinstance(inner, list):
+                        walk(inner, local)
+                for h in getattr(stmt, "handlers", ()) or ():
+                    walk(h.body, local)
+
+        walk(fn.body, 0)
+
+    # -- MXT120: ungated mutations in a seeded scope --------------------
+    def _scan_gate(self, fn, stmts, recv, tainted_l, gated, mod,
+                   findings):
+        for stmt in stmts:
+            local_gated = gated
+            if isinstance(stmt, (ast.If, ast.While)) and \
+                    names_in(stmt.test) & tainted_l:
+                local_gated = True
+            for expr in self._own_exprs(stmt):
+                for sub in ast.walk(expr):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    name = call_name(sub)
+                    tail = (name or "").rsplit(".", 1)[-1]
+                    if tail not in _MUTATORS:
+                        continue
+                    if gated:
+                        continue
+                    findings.append(Finding(
+                        code="MXT120", path=mod.relpath,
+                        line=sub.lineno,
+                        message=f"mutator {name!r} called in a guarded "
+                                f"scope without consulting the verdict "
+                                f"— the anomalous update commits "
+                                f"anyway",
+                        hint="gate the mutation on the agreed verdict "
+                             "(if verdict == 'ok': ... / the "
+                             "Guard.action ladder), or carry a "
+                             "reasoned `# mxtpu: noqa[MXT120]` if this "
+                             "mutation is deliberately verdict-free",
+                        scope=mod.qualname(sub),
+                        key=f"ungated:{tail}",
+                        col=sub.col_offset))
+            for field in ("body", "orelse", "finalbody"):
+                inner = getattr(stmt, field, None)
+                if inner and isinstance(inner, list):
+                    self._scan_gate(fn, inner, recv, tainted_l,
+                                    local_gated, mod, findings)
+            for h in getattr(stmt, "handlers", ()) or ():
+                self._scan_gate(fn, h.body, recv, tainted_l,
+                                local_gated, mod, findings)
+
+    @staticmethod
+    def _own_exprs(stmt):
+        """The statement's OWN expression subtrees — excludes nested
+        statement blocks (walked separately with their gate state) and
+        nested function/class definitions (their bodies are their own
+        scopes)."""
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return []
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.iter]
+        if isinstance(stmt, ast.With):
+            return [i.context_expr for i in stmt.items]
+        if isinstance(stmt, ast.Try):
+            return []
+        return [stmt]

@@ -30,6 +30,10 @@ HOT_ZONES = (
     "mxnet_tpu/parallel/bucketing.py",
     "mxnet_tpu/gluon/trainer.py",
     "mxnet_tpu/contrib/amp/loss_scaler.py",
+    # the numerical-integrity guard (ISSUE 20) runs INSIDE the step
+    # loop: its contract is ONE designed host sync per guarded step
+    # (the fused sentinel vector) — anything else must stay lazy
+    "mxnet_tpu/guard.py",
     "mxnet_tpu/module/bucketing_module.py",
     # the serving engine's step loop + page pool (ISSUE 8): one waived
     # token fetch per engine step is the design; everything else must

@@ -186,6 +186,8 @@ def cmd_blame(args):
     print(f"# verdict: {v['kind'].upper()}")
     if v.get("seq") is not None:
         print(f"  seq    {v['seq']}")
+    if v.get("step") is not None:
+        print(f"  step   {v['step']}")
     if v.get("tag"):
         print(f"  tag    {v['tag']}  (digest {v.get('digest')})")
     if v.get("ranks"):
